@@ -1,0 +1,28 @@
+"""tensorframes_tpu: a TPU-native framework for manipulating columnar
+DataFrames with tensor computation graphs.
+
+Brand-new design with the capabilities of the reference (TensorFrames,
+Spark + libtensorflow): five execution verbs — ``map_rows``, ``map_blocks``
+(+trimmed), ``reduce_rows``, ``reduce_blocks``, keyed ``aggregate`` — plus
+shape analysis (``analyze`` / ``print_schema`` / ``append_shape``) and
+placeholder inference (``block`` / ``row``). Graphs come from Python
+tracing, a builder DSL, or imported TF GraphDef protos; they are lowered to
+XLA via JAX, compiled once per (graph, block-shape) and sharded over a
+`jax.sharding.Mesh` — ICI collectives replace the reference's
+driver-funneled Spark reduces.
+"""
+
+__version__ = "0.1.0"
+
+from .frame import Column, TensorFrame
+from .schema import ColumnInfo, FrameInfo, ScalarType, Shape, Unknown
+
+__all__ = [
+    "Column",
+    "TensorFrame",
+    "ColumnInfo",
+    "FrameInfo",
+    "ScalarType",
+    "Shape",
+    "Unknown",
+]

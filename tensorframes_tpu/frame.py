@@ -1,0 +1,313 @@
+"""TensorFrame: a columnar, block-partitioned DataFrame for tensor compute.
+
+TPU-native replacement for the reference's Spark DataFrame substrate. Where
+the reference stored data as Spark `Row` objects and paid a boxed
+row->NIO-buffer conversion on every task (`DataOps.scala:63-81`,
+`datatypes.scala:114-127`), a TensorFrame stores each column as a dense
+numpy array of shape ``(nrows, *cell_shape)`` — already in tensor layout, so
+feeding a block to the accelerator is a zero-copy (or single-copy H2D) view.
+
+Ragged columns (rows with varying cell shapes — the reference supports these
+via per-row conversion, `TFDataOps.scala:90-103`) are stored as object
+arrays of per-row numpy cells; `analyze` merges their shapes with
+unknown-widening exactly like `ExperimentalOperations.scala:140-178`.
+
+Partitioning: a frame carries block boundaries (`offsets`). A *block* plays
+the role of a Spark partition: `map_blocks` applies the graph once per
+block, and distributed execution shards blocks across the device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .schema import ColumnInfo, FrameInfo, ScalarType, Shape, Unknown
+
+__all__ = ["TensorFrame", "Column"]
+
+ArrayLike = Union[np.ndarray, Sequence]
+
+
+class Column:
+    """One column: dense ndarray (lead dim = rows) or ragged object array."""
+
+    def __init__(self, name: str, data: ArrayLike, dtype: Optional[ScalarType] = None):
+        self.name = name
+        if isinstance(data, np.ndarray) and data.dtype != object:
+            self.values = data
+            self.ragged: Optional[List[np.ndarray]] = None
+            self.dtype = dtype or ScalarType.from_np_dtype(data.dtype)
+            # Dense storage: the cell shape is fully known.
+            self.cell_shape = Shape(data.shape[1:])
+        else:
+            cells = [np.asarray(x) for x in data]
+            if dtype is None:
+                if not cells:
+                    raise ValueError(f"empty ragged column {name!r} needs a dtype")
+                if cells[0].dtype.kind in ("U", "S", "O"):
+                    dtype = ScalarType.string
+                else:
+                    dtype = ScalarType.from_np_dtype(
+                        np.result_type(*[c.dtype for c in cells])
+                    )
+            self.dtype = dtype
+            if dtype is not ScalarType.string:
+                cells = [c.astype(dtype.np_dtype) for c in cells]
+            self.ragged = cells
+            # Without a scan we only know the rank (mirrors the reference:
+            # an ArrayType column has shape [Unknown,...] until analyzed,
+            # `ColumnInformation.scala:94-111`).
+            rank = cells[0].ndim if cells else 0
+            if any(c.ndim != rank for c in cells):
+                raise ValueError(f"column {name!r}: rows disagree on rank")
+            self.cell_shape = Shape((Unknown,) * rank)
+            self.values = None  # type: ignore[assignment]
+            self._try_densify()
+
+    def _try_densify(self) -> None:
+        """Promote a ragged column whose cells all share one shape to dense."""
+        if self.ragged is None or self.dtype is ScalarType.string:
+            return
+        if not self.ragged:
+            return
+        s0 = self.ragged[0].shape
+        if all(c.shape == s0 for c in self.ragged):
+            self.values = np.stack(self.ragged) if s0 else np.asarray(
+                [c[()] for c in self.ragged], dtype=self.dtype.np_dtype
+            )
+            self.values = self.values.astype(self.dtype.np_dtype)
+            self.cell_shape = Shape(s0)
+            self.ragged = None
+
+    # ------------------------------------------------------------------
+    @property
+    def is_dense(self) -> bool:
+        return self.ragged is None
+
+    def __len__(self) -> int:
+        return len(self.values) if self.is_dense else len(self.ragged)  # type: ignore[arg-type]
+
+    @property
+    def info(self) -> ColumnInfo:
+        return ColumnInfo(self.name, self.dtype, self.cell_shape)
+
+    def slice(self, start: int, stop: int) -> "Column":
+        if self.is_dense:
+            return Column(self.name, self.values[start:stop], self.dtype)
+        return Column(self.name, self.ragged[start:stop], self.dtype)  # type: ignore[index]
+
+    def row(self, i: int) -> np.ndarray:
+        return self.values[i] if self.is_dense else self.ragged[i]  # type: ignore[index]
+
+    def rows(self) -> Iterable[np.ndarray]:
+        return iter(self.values) if self.is_dense else iter(self.ragged)  # type: ignore[arg-type]
+
+    def analyzed_cell_shape(self) -> Shape:
+        """Scan all cells and merge shapes with unknown-widening
+        (`ExperimentalOperations.scala:140-178`)."""
+        if self.is_dense:
+            return self.cell_shape
+        merged: Optional[Shape] = None
+        for c in self.ragged:  # type: ignore[union-attr]
+            s = Shape(c.shape)
+            if merged is None:
+                merged = s
+            else:
+                m = merged.merge(s)
+                if m is None:
+                    raise ValueError(
+                        f"column {self.name!r}: rows disagree on rank "
+                        f"({merged} vs {s})"
+                    )
+                merged = m
+        return merged if merged is not None else self.cell_shape
+
+    def with_info(self, info: ColumnInfo) -> "Column":
+        c = Column.__new__(Column)
+        c.name = info.name
+        c.values = self.values
+        c.ragged = self.ragged
+        c.dtype = info.dtype
+        c.cell_shape = info.cell_shape
+        return c
+
+
+class TensorFrame:
+    """Columnar, block-partitioned frame.
+
+    ``offsets`` are block boundaries: block i covers rows
+    ``offsets[i]:offsets[i+1]``. Blocks correspond to the reference's Spark
+    partitions (each `map_blocks` graph application sees one block,
+    `DebugRowOps.scala:384-398`).
+    """
+
+    def __init__(
+        self,
+        columns: Sequence[Column],
+        offsets: Optional[Sequence[int]] = None,
+    ):
+        if not columns:
+            raise ValueError("a TensorFrame needs at least one column")
+        self._cols: Dict[str, Column] = {}
+        n = len(columns[0])
+        for c in columns:
+            if len(c) != n:
+                raise ValueError(
+                    f"column {c.name!r} has {len(c)} rows, expected {n}"
+                )
+            if c.name in self._cols:
+                raise ValueError(f"duplicate column {c.name!r}")
+            self._cols[c.name] = c
+        self.nrows = n
+        if offsets is None:
+            offsets = [0, n]
+        offsets = list(offsets)
+        if offsets[0] != 0 or offsets[-1] != n or any(
+            offsets[i] > offsets[i + 1] for i in range(len(offsets) - 1)
+        ):
+            raise ValueError(f"bad block offsets {offsets} for {n} rows")
+        self.offsets = offsets
+
+    # ---- constructors --------------------------------------------------
+    @classmethod
+    def from_dict(
+        cls,
+        data: Dict[str, ArrayLike],
+        num_blocks: Optional[int] = None,
+        dtypes: Optional[Dict[str, ScalarType]] = None,
+    ) -> "TensorFrame":
+        cols = [
+            Column(name, values, (dtypes or {}).get(name))
+            for name, values in data.items()
+        ]
+        tf = cls(cols)
+        if num_blocks is not None:
+            tf = tf.repartition(num_blocks)
+        return tf
+
+    @classmethod
+    def from_pandas(cls, pdf, num_blocks: Optional[int] = None) -> "TensorFrame":
+        data = {}
+        for name in pdf.columns:
+            series = pdf[name]
+            if series.dtype == object:
+                data[name] = list(series)
+            else:
+                data[name] = series.to_numpy()
+        return cls.from_dict(data, num_blocks=num_blocks)
+
+    @classmethod
+    def from_rows(
+        cls,
+        rows: Sequence[Dict[str, ArrayLike]],
+        num_blocks: Optional[int] = None,
+    ) -> "TensorFrame":
+        if not rows:
+            raise ValueError("from_rows needs at least one row")
+        names = list(rows[0].keys())
+        data = {n: [r[n] for r in rows] for n in names}
+        return cls.from_dict(data, num_blocks=num_blocks)
+
+    # ---- basic accessors ----------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols.keys())
+
+    @property
+    def info(self) -> FrameInfo:
+        return FrameInfo([c.info for c in self._cols.values()])
+
+    def column(self, name: str) -> Column:
+        if name not in self._cols:
+            raise KeyError(
+                f"no column {name!r}; available: {self.columns}"
+            )
+        return self._cols[name]
+
+    def __getitem__(self, name: str) -> Column:
+        return self.column(name)
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.offsets) - 1
+
+    def block_sizes(self) -> List[int]:
+        return [
+            self.offsets[i + 1] - self.offsets[i]
+            for i in range(self.num_blocks)
+        ]
+
+    def block(self, i: int) -> "TensorFrame":
+        lo, hi = self.offsets[i], self.offsets[i + 1]
+        return TensorFrame([c.slice(lo, hi) for c in self._cols.values()])
+
+    def blocks(self) -> Iterable["TensorFrame"]:
+        for i in range(self.num_blocks):
+            yield self.block(i)
+
+    # ---- restructuring -------------------------------------------------
+    def repartition(self, num_blocks: int) -> "TensorFrame":
+        """Split into ``num_blocks`` near-equal blocks (like df.repartition)."""
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        edges = np.linspace(0, self.nrows, num_blocks + 1).astype(int)
+        return TensorFrame(list(self._cols.values()), list(edges))
+
+    def select(self, names: Sequence[str]) -> "TensorFrame":
+        return TensorFrame([self.column(n) for n in names], self.offsets)
+
+    def with_columns(self, cols: Sequence[Column]) -> "TensorFrame":
+        merged = dict(self._cols)
+        for c in cols:
+            merged[c.name] = c
+        return TensorFrame(list(merged.values()), self.offsets)
+
+    # ---- schema ops (analyze / append_shape) ---------------------------
+    def analyze(self) -> "TensorFrame":
+        """Scan data, refine every column's cell shape
+        (`ExperimentalOperations.analyze`, `ExperimentalOperations.scala:39-51`)."""
+        new_cols = []
+        for c in self._cols.values():
+            info = ColumnInfo(c.name, c.dtype, c.analyzed_cell_shape())
+            new_cols.append(c.with_info(info))
+        return TensorFrame(new_cols, self.offsets)
+
+    def append_shape(self, name: str, cell_shape: Shape) -> "TensorFrame":
+        """Manually attach a cell shape (`ExperimentalOperations.scala:53-68`)."""
+        c = self.column(name)
+        info = ColumnInfo(name, c.dtype, cell_shape)
+        cols = [
+            c.with_info(info) if cn == name else col
+            for cn, col in self._cols.items()
+        ]
+        return TensorFrame(cols, self.offsets)
+
+    # ---- export --------------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for c in self._cols.values():
+            if c.is_dense and c.cell_shape.is_scalar:
+                data[c.name] = c.values
+            else:
+                data[c.name] = [np.asarray(r).tolist() for r in c.rows()]
+        return pd.DataFrame(data)
+
+    def collect(self) -> List[Dict[str, np.ndarray]]:
+        names = self.columns
+        return [
+            {n: self._cols[n].row(i) for n in names}
+            for i in range(self.nrows)
+        ]
+
+    def print_schema(self) -> None:
+        print(self.info.explain())
+
+    def __repr__(self) -> str:
+        return (
+            f"TensorFrame[{self.nrows} rows x {len(self._cols)} cols, "
+            f"{self.num_blocks} blocks]({', '.join(map(repr, self.info))})"
+        )

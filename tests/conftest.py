@@ -1,0 +1,16 @@
+"""Test fixture: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's test strategy of simulating the cluster locally
+(`local[1]` SparkContext with 4 shuffle partitions,
+`TensorFlossTestSparkContext.scala:14-22`): multi-chip behavior is tested on
+virtual CPU devices; the real chip is exercised by `bench.py`.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

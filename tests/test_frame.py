@@ -1,0 +1,106 @@
+"""TensorFrame tests (mirror ExtraOperationsSuite's analyze coverage)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.frame import Column, TensorFrame
+from tensorframes_tpu.schema import ScalarType, Shape
+
+
+class TestColumn:
+    def test_dense_scalar(self):
+        c = Column("x", np.arange(5, dtype=np.float64))
+        assert c.is_dense
+        assert c.cell_shape == Shape(())
+        assert c.dtype is ScalarType.float64
+        assert len(c) == 5
+
+    def test_dense_vector(self):
+        c = Column("x", np.ones((4, 3), dtype=np.float32))
+        assert c.cell_shape == Shape((3,))
+
+    def test_ragged_densifies_when_uniform(self):
+        c = Column("x", [np.ones(3), np.zeros(3)])
+        assert c.is_dense
+        assert c.cell_shape == Shape((3,))
+
+    def test_ragged_stays_ragged(self):
+        c = Column("x", [np.ones(2), np.zeros(3)])
+        assert not c.is_dense
+        assert c.cell_shape == Shape((None,))  # rank known, dims not
+
+    def test_ragged_analyze(self):
+        c = Column("x", [np.ones(2), np.zeros(3)])
+        assert c.analyzed_cell_shape() == Shape((None,))
+        c2 = Column("y", [np.ones((2, 4)), np.zeros((3, 4))])
+        assert c2.analyzed_cell_shape() == Shape((None, 4))
+
+    def test_rank_mismatch(self):
+        with pytest.raises(ValueError):
+            Column("x", [np.ones(2), np.zeros((2, 2))])
+
+    def test_string_column(self):
+        c = Column("s", ["ab", "cde"])
+        assert c.dtype is ScalarType.string
+
+
+class TestTensorFrame:
+    def test_from_dict_blocks(self):
+        tf = TensorFrame.from_dict({"x": np.arange(10.0)}, num_blocks=3)
+        assert tf.nrows == 10
+        assert tf.num_blocks == 3
+        assert sum(tf.block_sizes()) == 10
+        # blocks cover the rows exactly
+        rows = np.concatenate([b["x"].values for b in tf.blocks()])
+        np.testing.assert_array_equal(rows, np.arange(10.0))
+
+    def test_uneven_blocks(self):
+        # the reference tests explicit uneven partitions
+        # (BasicOperationsSuite.scala:219-227)
+        tf = TensorFrame.from_dict({"x": np.arange(5.0)}, num_blocks=3)
+        assert tf.num_blocks == 3
+        assert sum(tf.block_sizes()) == 5
+
+    def test_column_mismatch(self):
+        with pytest.raises(ValueError):
+            TensorFrame([Column("a", np.ones(2)), Column("b", np.ones(3))])
+
+    def test_analyze_refines_shape(self):
+        tf = TensorFrame.from_dict({"x": [np.ones(3), 2 * np.ones(3), np.zeros(4)]})
+        assert tf.info["x"].cell_shape == Shape((None,))
+        tf2 = tf.analyze()
+        assert tf2.info["x"].cell_shape == Shape((None,))
+        tf3 = TensorFrame.from_dict({"x": [np.ones((2, 5)), np.ones((3, 5))]})
+        assert tf3.analyze().info["x"].cell_shape == Shape((None, 5))
+
+    def test_append_shape(self):
+        tf = TensorFrame.from_dict({"x": [np.ones(3), np.ones(3), np.ones(4)]})
+        tf2 = tf.append_shape("x", Shape((None,)))
+        assert tf2.info["x"].cell_shape == Shape((None,))
+
+    def test_pandas_roundtrip(self):
+        import pandas as pd
+
+        pdf = pd.DataFrame({"x": [1.0, 2.0], "y": [[1.0, 2.0], [3.0, 4.0]]})
+        tf = TensorFrame.from_pandas(pdf)
+        assert tf.info["x"].cell_shape == Shape(())
+        assert tf.info["y"].cell_shape == Shape((2,))
+        back = tf.to_pandas()
+        assert list(back["x"]) == [1.0, 2.0]
+        assert back["y"][0] == [1.0, 2.0]
+
+    def test_collect(self):
+        tf = TensorFrame.from_dict({"x": np.arange(3.0)})
+        rows = tf.collect()
+        assert len(rows) == 3
+        assert rows[1]["x"] == 1.0
+
+    def test_select_and_with_columns(self):
+        tf = TensorFrame.from_dict({"a": np.ones(4), "b": np.zeros(4)})
+        assert tf.select(["b"]).columns == ["b"]
+        tf2 = tf.with_columns([Column("c", np.full(4, 7.0))])
+        assert set(tf2.columns) == {"a", "b", "c"}
+
+    def test_from_rows(self):
+        tf = TensorFrame.from_rows([{"x": 1.0}, {"x": 2.0}])
+        assert tf.nrows == 2

@@ -1,0 +1,123 @@
+"""Schema-layer tests (mirror ExtraOperationsSuite + Shape.scala semantics)."""
+
+import numpy as np
+import pytest
+
+from tensorframes_tpu.schema import (
+    ColumnInfo,
+    FrameInfo,
+    ScalarType,
+    Shape,
+    Unknown,
+    UnsupportedTypeError,
+)
+
+
+class TestShape:
+    def test_basic(self):
+        s = Shape((2, 3))
+        assert s.rank == 2
+        assert s.num_elements == 6
+        assert not s.has_unknown
+
+    def test_unknown_normalization(self):
+        # -1 and None both mean unknown (the reference uses -1).
+        assert Shape((-1, 3)) == Shape((None, 3))
+        assert Shape((None, 3)).has_unknown
+        assert Shape((None, 3)).num_elements is None
+
+    def test_prepend_tail(self):
+        cell = Shape((3,))
+        block = cell.prepend(Unknown)
+        assert block == Shape((None, 3))
+        assert block.tail == cell
+        assert Shape((2, 3)).drop_inner() == Shape((2,))
+
+    def test_scalar(self):
+        s = Shape.scalar()
+        assert s.is_scalar and s.num_elements == 1
+        with pytest.raises(ValueError):
+            _ = s.tail
+
+    def test_more_precise_than(self):
+        # Shape.scala:54-59 semantics.
+        assert Shape((2, 3)).check_more_precise_than(Shape((None, 3)))
+        assert Shape((2, 3)).check_more_precise_than(Shape((2, 3)))
+        assert not Shape((None, 3)).check_more_precise_than(Shape((2, 3)))
+        assert not Shape((2, 4)).check_more_precise_than(Shape((2, 3)))
+        assert not Shape((2, 3)).check_more_precise_than(Shape((2, 3, 4)))
+
+    def test_merge_widening(self):
+        # ExperimentalOperations.scala:168-178 semantics.
+        assert Shape((2, 3)).merge(Shape((2, 3))) == Shape((2, 3))
+        assert Shape((2, 3)).merge(Shape((4, 3))) == Shape((None, 3))
+        assert Shape((2,)).merge(Shape((2, 3))) is None
+
+    def test_assert_concrete(self):
+        assert Shape((2, 3)).assert_concrete() == (2, 3)
+        with pytest.raises(ValueError):
+            Shape((None,)).assert_concrete()
+
+    def test_repr(self):
+        assert repr(Shape((None, 3))) == "[?,3]"
+
+
+class TestScalarType:
+    def test_numpy_roundtrip(self):
+        for st in ScalarType:
+            if st is ScalarType.string:
+                continue
+            assert ScalarType.from_np_dtype(st.np_dtype) is st
+
+    def test_tf_datatype_roundtrip(self):
+        for st in ScalarType:
+            assert ScalarType.from_tf_datatype(st.tf_datatype) is st
+
+    def test_tf_enum_values(self):
+        # Public wire contract of types.proto.
+        assert ScalarType.float32.tf_datatype == 1
+        assert ScalarType.float64.tf_datatype == 2
+        assert ScalarType.int32.tf_datatype == 3
+        assert ScalarType.int64.tf_datatype == 9
+        assert ScalarType.string.tf_datatype == 7
+        assert ScalarType.bfloat16.tf_datatype == 14
+
+    def test_ref_dtype_normalized(self):
+        # DT_FLOAT_REF = 101 -> float32
+        assert ScalarType.from_tf_datatype(101) is ScalarType.float32
+
+    def test_unsupported(self):
+        with pytest.raises(UnsupportedTypeError):
+            ScalarType.from_tf_datatype(8)  # complex64
+
+    def test_bfloat16_numpy(self):
+        dt = ScalarType.bfloat16.np_dtype
+        assert np.dtype(dt).itemsize == 2
+
+
+class TestFrameInfo:
+    def test_block_shape(self):
+        ci = ColumnInfo("x", ScalarType.float64, Shape((3,)))
+        assert ci.block_shape == Shape((None, 3))
+
+    def test_lookup_and_explain(self):
+        fi = FrameInfo(
+            [
+                ColumnInfo("a", ScalarType.float64, Shape(())),
+                ColumnInfo("b", ScalarType.int32, Shape((2,))),
+            ]
+        )
+        assert "a" in fi and "z" not in fi
+        assert fi["b"].dtype is ScalarType.int32
+        txt = fi.explain()
+        assert "a: float64 []" in txt
+        assert "b: int32 [2]" in txt
+
+    def test_duplicate_names(self):
+        with pytest.raises(ValueError):
+            FrameInfo(
+                [
+                    ColumnInfo("a", ScalarType.float64, Shape(())),
+                    ColumnInfo("a", ScalarType.float64, Shape(())),
+                ]
+            )

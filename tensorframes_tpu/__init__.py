@@ -14,6 +14,14 @@ driver-funneled Spark reduces.
 
 __version__ = "0.1.0"
 
+# The reference's primary scalar type is float64 (`datatypes.scala:328+`);
+# JAX silently downcasts to float32 unless x64 is enabled. TPU execution
+# paths should still prefer float32/bfloat16 columns (the MXU's native
+# types) — x64 here is about *correctness parity* for double columns.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
 from .frame import Column, TensorFrame
 from .schema import ColumnInfo, FrameInfo, ScalarType, Shape, Unknown
 

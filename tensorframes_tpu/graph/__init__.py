@@ -1,0 +1,14 @@
+"""Graph layer: IR, GraphDef import/export, analysis, builder DSL."""
+
+from .analysis import GraphSummary, NodeSummary, ShapeHints, analyze_graph
+from .ir import Graph, GraphNode, parse_edge
+
+__all__ = [
+    "Graph",
+    "GraphNode",
+    "parse_edge",
+    "GraphSummary",
+    "NodeSummary",
+    "ShapeHints",
+    "analyze_graph",
+]

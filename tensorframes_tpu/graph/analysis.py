@@ -1,0 +1,142 @@
+"""Graph analysis: input/output classification + shape & dtype inference.
+
+TPU-native counterpart of `TensorFlowOps.analyzeGraphTF`
+(`TensorFlowOps.scala:101-141`): where the reference imported the graph into
+a native TF runtime and read back each op's static shape, we lower the
+graph with JAX and run `jax.eval_shape` — an abstract interpretation that
+never touches a device — under two different *probe* substitutions for the
+unknown dims. Dims that stay constant across probes are known; dims that
+track the probe are unknown. This recovers TF's partial static shapes
+without a hand-written symbolic shape-inference engine.
+
+`ShapeHints` mirrors `ShapeDescription` (`ShapeDescription.scala:12-19`):
+per-call output-shape hints (which override pruned/unknown inferred dims,
+`TensorFlowOps.scala:123-133`), the requested fetches, and the
+placeholder->column feed map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..ops.lowering import build_callable
+from ..schema import ScalarType, Shape
+from .ir import Graph, GraphNode, parse_edge
+
+__all__ = ["ShapeHints", "NodeSummary", "GraphSummary", "analyze_graph"]
+
+# Probe sizes for unknown dims: distinct, small, unlikely to collide with
+# real fixed dims in tandem (a dim must equal BOTH probes to be mistaken
+# for unknown, which is impossible since they differ).
+_PROBES = (3, 5)
+
+
+@dataclass
+class ShapeHints:
+    """Per-call side-channel (`ShapeDescription.scala:12-19`)."""
+
+    out_shapes: Dict[str, Shape] = field(default_factory=dict)
+    requested_fetches: List[str] = field(default_factory=list)
+    feed_map: Dict[str, str] = field(default_factory=dict)  # placeholder -> column
+
+
+@dataclass
+class NodeSummary:
+    """`GraphNodeSummary` (`TensorFlowOps.scala:163-169`)."""
+
+    name: str
+    is_input: bool
+    is_output: bool
+    dtype: ScalarType
+    shape: Shape  # may contain unknown dims
+
+
+@dataclass
+class GraphSummary:
+    inputs: Dict[str, NodeSummary]
+    outputs: Dict[str, NodeSummary]
+
+
+def _placeholder_spec(
+    node: GraphNode, overrides: Dict[str, Shape]
+) -> (ScalarType, Shape):
+    dtype = node.dtype_attr
+    if dtype is None:
+        raise ValueError(f"placeholder {node.name!r} has no dtype attr")
+    shape = overrides.get(node.name, node.shape_attr)
+    if shape is None:
+        raise ValueError(
+            f"placeholder {node.name!r} has no shape (attr or hint); "
+            "the reference requires placeholder shapes too "
+            "(core.py:72-92 records them for every op)"
+        )
+    return dtype, shape
+
+
+def _concretize(shape: Shape, probe: int) -> tuple:
+    return tuple(probe if d is None else d for d in shape.dims)
+
+
+def analyze_graph(
+    graph: Graph,
+    fetches: Sequence[str],
+    hints: Optional[ShapeHints] = None,
+    placeholder_shapes: Optional[Dict[str, Shape]] = None,
+) -> GraphSummary:
+    """Classify inputs/outputs and infer dtypes + partial shapes.
+
+    ``placeholder_shapes`` overrides placeholder shape attrs (used by the
+    verbs to inject column block shapes before validation).
+    """
+    hints = hints or ShapeHints()
+    overrides = dict(placeholder_shapes or {})
+    phs = graph.placeholders()
+    inputs: Dict[str, NodeSummary] = {}
+    for ph in phs:
+        dtype, shape = _placeholder_spec(ph, overrides)
+        inputs[ph.name] = NodeSummary(ph.name, True, False, dtype, shape)
+
+    fetch_list = list(fetches)
+    feed_names = [ph.name for ph in phs]
+    fn = build_callable(graph, fetch_list, feed_names)
+
+    per_probe: List[List] = []
+    for probe in _PROBES:
+        structs = [
+            jax.ShapeDtypeStruct(
+                _concretize(inputs[name].shape, probe),
+                inputs[name].dtype.np_dtype,
+            )
+            for name in feed_names
+        ]
+        outs = jax.eval_shape(fn, *structs)
+        per_probe.append(list(outs))
+
+    outputs: Dict[str, NodeSummary] = {}
+    for i, f in enumerate(fetch_list):
+        base = parse_edge(f)[0]
+        a, b = per_probe[0][i], per_probe[1][i]
+        merged = Shape(a.shape).merge(Shape(b.shape))
+        if merged is None:
+            # rank varied with the probe — fully dynamic; fall back to hint
+            merged = hints.out_shapes.get(base)
+            if merged is None:
+                raise ValueError(
+                    f"fetch {f!r}: output rank depends on the block size and "
+                    "no shape hint was provided"
+                )
+        hint = hints.out_shapes.get(base)
+        if hint is not None and hint.rank == merged.rank:
+            # Hints override unknown inferred dims (TensorFlowOps.scala:123-133).
+            merged = Shape(
+                m if m is not None else h
+                for m, h in zip(merged.dims, hint.dims)
+            )
+        dtype = ScalarType.from_np_dtype(np.dtype(a.dtype))
+        outputs[base] = NodeSummary(base, False, True, dtype, merged)
+
+    return GraphSummary(inputs=inputs, outputs=outputs)

@@ -1,0 +1,424 @@
+"""Builder DSL: author graphs without TensorFlow, mirroring the reference's
+Scala DSL (`dsl/package.scala`, `dsl/Operation.scala`, `dsl/DslImpl.scala`).
+
+Nodes are built lazily ("freeze" semantics, `Operation.scala:86-104`): a
+`Tensor` handle records op/parents/attrs; names are assigned at `build()`
+time — requested names win, anonymous nodes get TF-style ``op_N`` counters
+scoped by `scope()` (the reference's `Paths`, made re-entrant and
+thread-safe here via contextvars — the original is documented
+thread-UNSAFE, `dsl/Paths.scala:10-12`).
+
+The DSL emits the same TF-compatible NodeDefs as the import path, so DSL
+graphs export to GraphDef wire bytes byte-for-byte comparably to graphs a
+real TF would build (the reference asserts exactly this in its
+`ExtractNodes` golden tests, `dsl/ExtractNodes.scala:14-77`).
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..proto.graphdef import AttrValue, TensorProto
+from ..schema import ScalarType, Shape
+from .ir import Graph, GraphNode
+
+__all__ = [
+    "Tensor",
+    "scope",
+    "placeholder",
+    "constant",
+    "zeros",
+    "ones",
+    "fill",
+    "identity",
+    "add",
+    "sub",
+    "mul",
+    "div",
+    "matmul",
+    "square",
+    "sqrt",
+    "reduce_sum",
+    "reduce_min",
+    "reduce_max",
+    "reduce_mean",
+    "cast",
+    "reshape",
+    "expand_dims",
+    "concat",
+    "argmin",
+    "argmax",
+    "unsorted_segment_sum",
+    "relu",
+    "softmax",
+    "sigmoid",
+    "tanh",
+    "build",
+    "block",
+    "row",
+]
+
+_scope_stack: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "tfs_dsl_scope", default=()
+)
+
+
+@contextmanager
+def scope(name: str):
+    """Name scope, like `dsl.scope` / TF name scopes (`Paths.scala:13-56`)."""
+    tok = _scope_stack.set(_scope_stack.get() + (name,))
+    try:
+        yield
+    finally:
+        _scope_stack.reset(tok)
+
+
+class Tensor:
+    """Handle to one output of an unfrozen DSL node."""
+
+    def __init__(
+        self,
+        op: str,
+        parents: Sequence["Tensor"],
+        attrs: Dict[str, AttrValue],
+        dtype: ScalarType,
+        requested_name: Optional[str] = None,
+        idx: int = 0,
+        source: Optional["Tensor"] = None,
+    ):
+        self.op = op
+        self.parents = list(parents)
+        self.attrs = dict(attrs)
+        self.dtype = dtype
+        self.requested_name = requested_name
+        self.scope_path = _scope_stack.get()
+        self.idx = idx
+        self.source = source  # for multi-output handles: the defining node
+
+    # -- naming ----------------------------------------------------------
+    def named(self, name: str) -> "Tensor":
+        """Request an explicit node name (`Operation.named`)."""
+        self.requested_name = name
+        return self
+
+    # -- operators (implicit constant conversion, dsl/Implicits.scala) ---
+    def _coerce(self, other) -> "Tensor":
+        if isinstance(other, Tensor):
+            return other
+        return constant(np.asarray(other, dtype=self.dtype.np_dtype))
+
+    def __add__(self, other):
+        return add(self, self._coerce(other))
+
+    def __radd__(self, other):
+        return add(self._coerce(other), self)
+
+    def __sub__(self, other):
+        return sub(self, self._coerce(other))
+
+    def __rsub__(self, other):
+        return sub(self._coerce(other), self)
+
+    def __mul__(self, other):
+        return mul(self, self._coerce(other))
+
+    def __rmul__(self, other):
+        return mul(self._coerce(other), self)
+
+    def __truediv__(self, other):
+        return div(self, self._coerce(other))
+
+    def __rtruediv__(self, other):
+        return div(self._coerce(other), self)
+
+    def __neg__(self):
+        return _nary("Neg", [self])
+
+    def __repr__(self) -> str:
+        nm = self.requested_name or "?"
+        return f"<dsl.Tensor {self.op} {nm} {self.dtype.name}>"
+
+
+# ---------------------------------------------------------------------------
+# node factories
+# ---------------------------------------------------------------------------
+
+
+def _same_dtype(a: Tensor, b: Tensor, op: str) -> ScalarType:
+    if a.dtype is not b.dtype:
+        raise ValueError(
+            f"{op}: dtype mismatch {a.dtype.name} vs {b.dtype.name} "
+            "(TF graphs do not promote dtypes; cast explicitly)"
+        )
+    return a.dtype
+
+
+def placeholder(
+    dtype: ScalarType, shape: Shape, name: Optional[str] = None
+) -> Tensor:
+    attrs = {
+        "dtype": AttrValue.of_type(dtype),
+        "shape": AttrValue.of_shape(shape),
+    }
+    return Tensor("Placeholder", [], attrs, dtype, requested_name=name)
+
+
+def constant(
+    value, dtype: Optional[ScalarType] = None, name: Optional[str] = None
+) -> Tensor:
+    arr = np.asarray(value)
+    if dtype is not None:
+        arr = arr.astype(dtype.np_dtype)
+    elif arr.dtype == np.float64:
+        pass  # keep doubles as doubles, like the Scala DSL
+    st = ScalarType.from_np_dtype(arr.dtype)
+    attrs = {
+        "dtype": AttrValue.of_type(st),
+        "value": AttrValue.of_tensor(TensorProto.from_numpy(arr)),
+    }
+    return Tensor("Const", [], attrs, st, requested_name=name)
+
+
+def zeros(shape, dtype: ScalarType = ScalarType.float64) -> Tensor:
+    return constant(np.zeros(shape, dtype=dtype.np_dtype))
+
+
+def ones(shape, dtype: ScalarType = ScalarType.float64) -> Tensor:
+    return constant(np.ones(shape, dtype=dtype.np_dtype))
+
+
+def fill(shape, value, dtype: Optional[ScalarType] = None) -> Tensor:
+    return constant(np.full(shape, value, dtype=dtype.np_dtype if dtype else None))
+
+
+def _nary(
+    op: str,
+    parents: List[Tensor],
+    dtype: Optional[ScalarType] = None,
+    extra_attrs: Optional[Dict[str, AttrValue]] = None,
+    name: Optional[str] = None,
+) -> Tensor:
+    dt = dtype or parents[0].dtype
+    attrs = {"T": AttrValue.of_type(dt)}
+    attrs.update(extra_attrs or {})
+    return Tensor(op, parents, attrs, dt, requested_name=name)
+
+
+def identity(x: Tensor, name: Optional[str] = None) -> Tensor:
+    return _nary("Identity", [x], name=name)
+
+
+def add(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+    return _nary("Add", [a, b], _same_dtype(a, b, "add"), name=name)
+
+
+def sub(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+    return _nary("Sub", [a, b], _same_dtype(a, b, "sub"), name=name)
+
+
+def mul(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+    return _nary("Mul", [a, b], _same_dtype(a, b, "mul"), name=name)
+
+
+def div(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
+    return _nary("Div", [a, b], _same_dtype(a, b, "div"), name=name)
+
+
+def matmul(a: Tensor, b: Tensor, transpose_a=False, transpose_b=False) -> Tensor:
+    extra = {
+        "transpose_a": AttrValue.of_bool(transpose_a),
+        "transpose_b": AttrValue.of_bool(transpose_b),
+    }
+    return _nary("MatMul", [a, b], _same_dtype(a, b, "matmul"), extra)
+
+
+def square(x: Tensor) -> Tensor:
+    return _nary("Square", [x])
+
+
+def sqrt(x: Tensor) -> Tensor:
+    return _nary("Sqrt", [x])
+
+
+def relu(x: Tensor) -> Tensor:
+    return _nary("Relu", [x])
+
+
+def softmax(x: Tensor) -> Tensor:
+    return _nary("Softmax", [x])
+
+
+def sigmoid(x: Tensor) -> Tensor:
+    return _nary("Sigmoid", [x])
+
+
+def tanh(x: Tensor) -> Tensor:
+    return _nary("Tanh", [x])
+
+
+def cast(x: Tensor, dtype: ScalarType) -> Tensor:
+    attrs = {
+        "SrcT": AttrValue.of_type(x.dtype),
+        "DstT": AttrValue.of_type(dtype),
+    }
+    return Tensor("Cast", [x], attrs, dtype)
+
+
+def reshape(x: Tensor, shape: Sequence[int]) -> Tensor:
+    shp = constant(np.asarray(shape, dtype=np.int32))
+    return _nary("Reshape", [x, shp])
+
+
+def expand_dims(x: Tensor, axis: int) -> Tensor:
+    return _nary("ExpandDims", [x, constant(np.int32(axis))])
+
+
+def concat(xs: Sequence[Tensor], axis: int) -> Tensor:
+    ax = constant(np.int32(axis))
+    return _nary("ConcatV2", list(xs) + [ax], xs[0].dtype,
+                 {"N": AttrValue.of_int(len(xs))})
+
+
+def _reducer(
+    op: str, x: Tensor, axes: Optional[Sequence[int]], keep_dims: bool
+) -> Tensor:
+    """Reduction with a `reduction_indices` Const child, matching
+    `DslImpl.build_reducer` (`DslImpl.scala:175-188`)."""
+    if axes is None:
+        axes = []
+    idx = constant(np.asarray(list(axes), dtype=np.int32))
+    extra = {
+        "keep_dims": AttrValue.of_bool(keep_dims),
+        "Tidx": AttrValue.of_type(ScalarType.int32),
+    }
+    return _nary(op, [x, idx], x.dtype, extra)
+
+
+def reduce_sum(x: Tensor, axes=None, keep_dims=False, name=None) -> Tensor:
+    return _reducer("Sum", x, axes, keep_dims).named(name) if name else _reducer(
+        "Sum", x, axes, keep_dims
+    )
+
+
+def reduce_min(x: Tensor, axes=None, keep_dims=False) -> Tensor:
+    return _reducer("Min", x, axes, keep_dims)
+
+
+def reduce_max(x: Tensor, axes=None, keep_dims=False) -> Tensor:
+    return _reducer("Max", x, axes, keep_dims)
+
+
+def reduce_mean(x: Tensor, axes=None, keep_dims=False) -> Tensor:
+    return _reducer("Mean", x, axes, keep_dims)
+
+
+def argmin(x: Tensor, axis: int = 0) -> Tensor:
+    t = _nary("ArgMin", [x, constant(np.int32(axis))], x.dtype)
+    t.dtype = ScalarType.int64
+    return t
+
+
+def argmax(x: Tensor, axis: int = 0) -> Tensor:
+    t = _nary("ArgMax", [x, constant(np.int32(axis))], x.dtype)
+    t.dtype = ScalarType.int64
+    return t
+
+
+def unsorted_segment_sum(data: Tensor, ids: Tensor, num_segments: int) -> Tensor:
+    n = constant(np.int32(num_segments))
+    return _nary(
+        "UnsortedSegmentSum", [data, ids, n], data.dtype,
+        {"Tindices": AttrValue.of_type(ids.dtype)},
+    )
+
+
+# ---------------------------------------------------------------------------
+# frame integration (dsl.block / dsl.row, `dsl/package.scala:92-112`)
+# ---------------------------------------------------------------------------
+
+
+def block(frame, col_name: str, tf_name: Optional[str] = None) -> Tensor:
+    """Placeholder matching a column's *block* (unknown lead dim), named
+    after the column (`extractPlaceholder`, `DslImpl.scala:90-107`)."""
+    info = frame.info[col_name]
+    return placeholder(
+        info.dtype, info.block_shape, name=tf_name or col_name
+    )
+
+
+def row(frame, col_name: str, tf_name: Optional[str] = None) -> Tensor:
+    """Placeholder matching a single row's cell of a column."""
+    info = frame.info[col_name]
+    return placeholder(info.dtype, info.cell_shape, name=tf_name or col_name)
+
+
+# ---------------------------------------------------------------------------
+# freeze: Tensor closure -> Graph
+# ---------------------------------------------------------------------------
+
+
+def build(fetches: Union[Tensor, Sequence[Tensor]]) -> (Graph, List[str]):
+    """Freeze the transitive closure of ``fetches`` into a `Graph`.
+
+    Returns (graph, fetch_names). Name assignment: requested names win;
+    anonymous nodes get ``<scope>/<op_lower>_<k>`` counters
+    (`Paths.scala:40-55`, `DslImpl.buildGraph`).
+    """
+    if isinstance(fetches, Tensor):
+        fetches = [fetches]
+    order: List[Tensor] = []
+    seen: Dict[int, bool] = {}
+
+    def visit(t: Tensor):
+        root = t.source or t
+        if id(root) in seen:
+            return
+        seen[id(root)] = True
+        for p in root.parents:
+            visit(p)
+        order.append(root)
+
+    for f in fetches:
+        visit(f)
+
+    counters: Dict[str, int] = {}
+    names: Dict[int, str] = {}
+    used = set()
+    for t in order:
+        if t.requested_name:
+            name = "/".join(t.scope_path + (t.requested_name,))
+        else:
+            base = "/".join(t.scope_path + (t.op,))
+            k = counters.get(base, 0)
+            name = base if k == 0 else f"{base}_{k}"
+            counters[base] = k + 1
+            while name in used:
+                k = counters[base]
+                name = f"{base}_{k}"
+                counters[base] = k + 1
+        if name in used:
+            raise ValueError(f"duplicate node name {name!r} in DSL graph")
+        used.add(name)
+        names[id(t)] = name
+
+    g = Graph()
+    for t in order:
+        edges = []
+        for p in t.parents:
+            root = p.source or p
+            e = names[id(root)]
+            if p.idx:
+                e = f"{e}:{p.idx}"
+            edges.append(e)
+        g.add(GraphNode(names[id(t)], t.op, edges, dict(t.attrs)))
+
+    fetch_names = []
+    for f in fetches:
+        root = f.source or f
+        n = names[id(root)]
+        fetch_names.append(f"{n}:{f.idx}" if f.idx else n)
+    return g, fetch_names

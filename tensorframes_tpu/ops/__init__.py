@@ -1,0 +1,15 @@
+"""Op registry and JAX lowerings for GraphDef ops."""
+
+from .lowering import GraphLoweringError, build_callable, supported
+from .registry import LowerCtx, OpRule, get_rule, register, registered_ops
+
+__all__ = [
+    "GraphLoweringError",
+    "build_callable",
+    "supported",
+    "LowerCtx",
+    "OpRule",
+    "get_rule",
+    "register",
+    "registered_ops",
+]

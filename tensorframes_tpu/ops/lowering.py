@@ -1,0 +1,93 @@
+"""Graph -> JAX callable lowering.
+
+`build_callable` turns a `Graph` + fetch list into a pure Python function
+over placeholder arrays. Calling it under `jax.jit` traces every node's
+lowering rule into one XLA computation — the whole graph becomes a single
+fused executable, where the reference paid a libtensorflow `session.run`
+per partition with per-op kernel dispatch (`DebugRowOps.scala:794-801`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+from ..graph.ir import Graph, parse_edge
+from .registry import GraphLoweringError, LowerCtx, get_rule
+from . import standard  # noqa: F401  (populates the registry)
+
+__all__ = ["build_callable", "supported", "GraphLoweringError"]
+
+
+def supported(graph: Graph, fetches: Sequence[str]) -> Tuple[bool, str]:
+    """Check that every op in the closure of ``fetches`` has a rule."""
+    for node in graph.toposort(list(fetches)):
+        if node.op in ("Placeholder", "PlaceholderV2"):
+            continue
+        if get_rule(node.op) is None:
+            return False, f"unsupported op {node.op!r} (node {node.name!r})"
+    return True, ""
+
+
+def build_callable(
+    graph: Graph, fetches: Sequence[str], feed_names: Sequence[str]
+) -> Callable[..., Tuple[Any, ...]]:
+    """Build ``fn(*feed_arrays) -> tuple(fetch_values)``.
+
+    ``feed_names`` fixes the positional order of placeholder arguments (so
+    the function is directly jittable). Fetches may use ``name:k`` syntax.
+    """
+    order = graph.toposort(list(fetches))
+    feed_pos = {name: i for i, name in enumerate(feed_names)}
+    ctx = LowerCtx()
+
+    for node in order:
+        if node.op in ("Placeholder", "PlaceholderV2"):
+            if node.name not in feed_pos:
+                raise GraphLoweringError(
+                    f"placeholder {node.name!r} is not fed; feeds: {list(feed_names)}"
+                )
+        elif get_rule(node.op) is None:
+            raise GraphLoweringError(
+                f"unsupported op {node.op!r} (node {node.name!r}); "
+                "see ops.registry.registered_ops()"
+            )
+
+    def fn(*feed_arrays):
+        if len(feed_arrays) != len(feed_pos):
+            raise ValueError(
+                f"expected {len(feed_pos)} feeds {list(feed_names)}, "
+                f"got {len(feed_arrays)}"
+            )
+        env: Dict[Tuple[str, int], Any] = {}
+        for node in order:
+            if node.op in ("Placeholder", "PlaceholderV2"):
+                env[(node.name, 0)] = feed_arrays[feed_pos[node.name]]
+                continue
+            ins: List[Any] = []
+            for edge in node.inputs:
+                dep, idx, ctrl = parse_edge(edge)
+                if ctrl:
+                    continue  # purely functional: control edges are ordering-only
+                key = (dep, idx)
+                if key not in env:
+                    raise GraphLoweringError(
+                        f"node {node.name!r} consumes output {idx} of {dep!r} "
+                        "which was not produced"
+                    )
+                ins.append(env[key])
+            out = get_rule(node.op).fn(ctx, node, ins)
+            if isinstance(out, tuple):
+                for i, v in enumerate(out):
+                    env[(node.name, i)] = v
+            else:
+                env[(node.name, 0)] = out
+        results = []
+        for f in fetches:
+            name, idx, _ = parse_edge(f)
+            key = (name, idx)
+            if key not in env:
+                raise GraphLoweringError(f"fetch {f!r} was not produced")
+            results.append(env[key])
+        return tuple(results)
+
+    return fn

@@ -1,0 +1,81 @@
+"""Op registry: maps TF op names to JAX lowering rules.
+
+The reference delegated op semantics wholesale to libtensorflow kernels
+(`TensorFlowOps.withSession`, session.run). Here each supported GraphDef op
+has a *lowering rule*: a function from input values to JAX values, executed
+while tracing the graph into a single XLA computation. XLA then fuses the
+whole graph — there is no per-op kernel dispatch at runtime.
+
+Static-value machinery: several TF ops take *data* inputs that must be
+compile-time constants under XLA (reshape targets, reduction axes, fill
+dims, ...). During lowering, `Const` nodes evaluate to numpy arrays and
+stay numpy until an op forces them onto the device; `LowerCtx.static`
+recovers such values (constant folding — the same job TF's variable
+freezing + GraphDef constant nodes did for the reference, `core.py:42-56`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..graph.ir import GraphNode
+
+__all__ = ["OpRule", "LowerCtx", "register", "get_rule", "GraphLoweringError", "registered_ops"]
+
+
+class GraphLoweringError(ValueError):
+    """Raised when a graph cannot be lowered to XLA."""
+
+
+@dataclass
+class OpRule:
+    name: str
+    # fn(ctx, node, inputs) -> value | tuple of values (multi-output ops)
+    fn: Callable[["LowerCtx", GraphNode, List[Any]], Any]
+
+
+_REGISTRY: Dict[str, OpRule] = {}
+
+
+def register(*names: str):
+    """Decorator: register a lowering rule under one or more TF op names."""
+
+    def deco(fn):
+        for n in names:
+            _REGISTRY[n] = OpRule(n, fn)
+        return fn
+
+    return deco
+
+
+def get_rule(op: str) -> Optional[OpRule]:
+    return _REGISTRY.get(op)
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class LowerCtx:
+    """Per-lowering context: static-value recovery + helpers."""
+
+    def static(self, value, node: GraphNode, what: str) -> np.ndarray:
+        """Return ``value`` as a host numpy array, or fail with a clear error
+        if it is a traced (data-dependent) value. Shape-of results and Const
+        nodes are always static."""
+        import jax
+
+        if isinstance(value, jax.core.Tracer):
+            raise GraphLoweringError(
+                f"op {node.op!r} (node {node.name!r}) requires a "
+                f"compile-time-constant {what}, but it is data-dependent. "
+                "XLA compiles static graphs; make this a Const."
+            )
+        return np.asarray(value)
+
+    def static_int_list(self, value, node: GraphNode, what: str) -> List[int]:
+        arr = self.static(value, node, what)
+        return [int(x) for x in np.atleast_1d(arr)]

@@ -1,0 +1,593 @@
+"""Standard op set: JAX lowerings for the TF GraphDef ops this framework
+executes.
+
+Coverage = the op families the reference's tests, demos, and configs
+exercise (SURVEY.md §7.2): the DSL core (Placeholder/Const/Identity/Add/
+Div/Sum/Min, `dsl/package.scala:32-133`), the k-means demo family
+(MatMul/Square/ArgMin/UnsortedSegmentSum, `kmeans_demo.py`), and the
+Inception-family conv ops (Conv2D/Pool/BatchNorm/Concat/Softmax), plus the
+surrounding elementwise/shape/segment ops any frozen TF-1.x graph leans on.
+
+Semantics notes (TF 1.x):
+- binary ops do NOT promote dtypes (the graph's ``T`` attr fixes one dtype);
+- ``Div`` on integers truncates toward zero (C semantics), ``FloorDiv``
+  floors; ``RealDiv`` is true division;
+- reductions take ``reduction_indices`` as a *tensor input* plus a
+  ``keep_dims`` attr (`DslImpl.scala:175-188`);
+- ``Conv2D``/pooling default to NHWC with explicit stride/ksize quads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..graph.ir import GraphNode
+from ..schema import ScalarType
+from .registry import GraphLoweringError, LowerCtx, register
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _is_int(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer)
+
+
+def _reduction_axes(ctx: LowerCtx, node: GraphNode, x, indices) -> tuple:
+    rank = jnp.ndim(x)
+    axes = ctx.static_int_list(indices, node, "reduction_indices")
+    return tuple(sorted(a % rank for a in axes)) if axes else tuple(range(rank))
+
+
+def _keep_dims(node: GraphNode) -> bool:
+    return bool(node.attr("keep_dims", node.attr("keepdims", False)))
+
+
+def _padding_str(node: GraphNode) -> str:
+    p = node.attr("padding", b"VALID")
+    return (p.decode() if isinstance(p, bytes) else str(p)).upper()
+
+
+def _data_format(node: GraphNode) -> str:
+    df = node.attr("data_format", b"NHWC")
+    return df.decode() if isinstance(df, bytes) else str(df)
+
+
+# ---------------------------------------------------------------------------
+# sources / identity
+# ---------------------------------------------------------------------------
+
+
+@register("Const")
+def _const(ctx, node, inputs):
+    av = node.attrs.get("value")
+    if av is None or av.kind != "tensor":
+        raise GraphLoweringError(f"Const node {node.name!r} has no value attr")
+    return av.value.to_numpy()  # stays host-side until an op needs it on device
+
+
+@register("Identity", "StopGradient", "PreventGradient", "CheckNumerics", "Snapshot")
+def _identity(ctx, node, inputs):
+    return inputs[0]
+
+
+@register("IdentityN")
+def _identity_n(ctx, node, inputs):
+    return tuple(inputs)
+
+
+@register("NoOp")
+def _noop(ctx, node, inputs):
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "Neg": jnp.negative,
+    "Abs": jnp.abs,
+    "Square": jnp.square,
+    "Sqrt": jnp.sqrt,
+    "Rsqrt": lambda x: lax.rsqrt(jnp.asarray(x)),
+    "Exp": jnp.exp,
+    "Log": jnp.log,
+    "Log1p": jnp.log1p,
+    "Expm1": jnp.expm1,
+    "Sign": jnp.sign,
+    "Floor": jnp.floor,
+    "Ceil": jnp.ceil,
+    "Round": jnp.round,
+    "Rint": jnp.round,
+    "Reciprocal": lambda x: 1 / jnp.asarray(x),
+    "Inv": lambda x: 1 / jnp.asarray(x),
+    "Tanh": jnp.tanh,
+    "Sigmoid": jax.nn.sigmoid,
+    "Relu": jax.nn.relu,
+    "Relu6": lambda x: jnp.clip(jnp.asarray(x), 0, 6),
+    "Elu": jax.nn.elu,
+    "Selu": jax.nn.selu,
+    "Softplus": jax.nn.softplus,
+    "Softsign": jax.nn.soft_sign,
+    "Erf": jax.scipy.special.erf,
+    "Sin": jnp.sin,
+    "Cos": jnp.cos,
+    "Tan": jnp.tan,
+    "Asin": jnp.arcsin,
+    "Acos": jnp.arccos,
+    "Atan": jnp.arctan,
+    "Sinh": jnp.sinh,
+    "Cosh": jnp.cosh,
+    "IsNan": jnp.isnan,
+    "IsInf": jnp.isinf,
+    "IsFinite": jnp.isfinite,
+    "LogicalNot": jnp.logical_not,
+    "OnesLike": jnp.ones_like,
+    "ZerosLike": jnp.zeros_like,
+}
+
+for _name, _fn in _UNARY.items():
+    register(_name)(lambda ctx, node, inputs, _fn=_fn: _fn(inputs[0]))
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+
+
+def _tf_div(x, y):
+    if _is_int(x) and _is_int(y):
+        return lax.div(jnp.asarray(x), jnp.asarray(y))  # C truncation
+    return jnp.true_divide(x, y)
+
+
+_BINARY = {
+    "Add": jnp.add,
+    "AddV2": jnp.add,
+    "Sub": jnp.subtract,
+    "Mul": jnp.multiply,
+    "Div": _tf_div,
+    "RealDiv": jnp.true_divide,
+    "TruncateDiv": _tf_div,
+    "FloorDiv": jnp.floor_divide,
+    "FloorMod": jnp.mod,
+    "Mod": jnp.mod,
+    "Maximum": jnp.maximum,
+    "Minimum": jnp.minimum,
+    "Pow": jnp.power,
+    "SquaredDifference": lambda x, y: jnp.square(jnp.subtract(x, y)),
+    "Atan2": jnp.arctan2,
+    "Equal": jnp.equal,
+    "NotEqual": jnp.not_equal,
+    "Less": jnp.less,
+    "LessEqual": jnp.less_equal,
+    "Greater": jnp.greater,
+    "GreaterEqual": jnp.greater_equal,
+    "LogicalAnd": jnp.logical_and,
+    "LogicalOr": jnp.logical_or,
+}
+
+for _name, _fn in _BINARY.items():
+    register(_name)(lambda ctx, node, inputs, _fn=_fn: _fn(inputs[0], inputs[1]))
+
+
+@register("AddN", "AccumulateNV2")
+def _add_n(ctx, node, inputs):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = jnp.add(out, x)
+    return out
+
+
+@register("Select", "SelectV2")
+def _select(ctx, node, inputs):
+    return jnp.where(inputs[0], inputs[1], inputs[2])
+
+
+@register("ClipByValue")
+def _clip(ctx, node, inputs):
+    return jnp.clip(inputs[0], inputs[1], inputs[2])
+
+
+# ---------------------------------------------------------------------------
+# reductions (input-tensor axes + keep_dims attr)
+# ---------------------------------------------------------------------------
+
+
+def _make_reducer(jfn):
+    def rule(ctx, node, inputs):
+        axes = _reduction_axes(ctx, node, inputs[0], inputs[1])
+        return jfn(inputs[0], axis=axes, keepdims=_keep_dims(node))
+
+    return rule
+
+
+register("Sum")(_make_reducer(jnp.sum))
+register("Prod")(_make_reducer(jnp.prod))
+register("Min")(_make_reducer(jnp.min))
+register("Max")(_make_reducer(jnp.max))
+register("Mean")(_make_reducer(jnp.mean))
+register("All")(_make_reducer(jnp.all))
+register("Any")(_make_reducer(jnp.any))
+
+
+@register("ArgMax")
+def _argmax(ctx, node, inputs):
+    axis = int(ctx.static(inputs[1], node, "dimension")) if len(inputs) > 1 else 0
+    out_t = node.attr("output_type", ScalarType.int64)
+    return jnp.argmax(inputs[0], axis=axis).astype(out_t.np_dtype)
+
+
+@register("ArgMin")
+def _argmin(ctx, node, inputs):
+    axis = int(ctx.static(inputs[1], node, "dimension")) if len(inputs) > 1 else 0
+    out_t = node.attr("output_type", ScalarType.int64)
+    return jnp.argmin(inputs[0], axis=axis).astype(out_t.np_dtype)
+
+
+# ---------------------------------------------------------------------------
+# segment ops (k-means / aggregate family)
+# ---------------------------------------------------------------------------
+
+
+@register("UnsortedSegmentSum")
+def _unsorted_segment_sum(ctx, node, inputs):
+    num = int(ctx.static(inputs[2], node, "num_segments"))
+    return jax.ops.segment_sum(jnp.asarray(inputs[0]), jnp.asarray(inputs[1]), num)
+
+
+@register("UnsortedSegmentMax")
+def _unsorted_segment_max(ctx, node, inputs):
+    num = int(ctx.static(inputs[2], node, "num_segments"))
+    return jax.ops.segment_max(jnp.asarray(inputs[0]), jnp.asarray(inputs[1]), num)
+
+
+@register("UnsortedSegmentMin")
+def _unsorted_segment_min(ctx, node, inputs):
+    num = int(ctx.static(inputs[2], node, "num_segments"))
+    return jax.ops.segment_min(jnp.asarray(inputs[0]), jnp.asarray(inputs[1]), num)
+
+
+@register("SegmentSum")
+def _segment_sum(ctx, node, inputs):
+    ids = ctx.static(inputs[1], node, "segment_ids (data-dependent segment "
+                     "count; use UnsortedSegmentSum with static num_segments)")
+    num = int(ids.max()) + 1 if ids.size else 0
+    return jax.ops.segment_sum(
+        jnp.asarray(inputs[0]), jnp.asarray(ids), num, indices_are_sorted=True
+    )
+
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+
+
+@register("MatMul", "BatchMatMul", "BatchMatMulV2")
+def _matmul(ctx, node, inputs):
+    a, b = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    ta = bool(node.attr("transpose_a", node.attr("adj_x", False)))
+    tb = bool(node.attr("transpose_b", node.attr("adj_y", False)))
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    # TF float32 matmul is true fp32; JAX's default lets the MXU use bf16
+    # passes. Request HIGHEST for numerical parity with the reference.
+    return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+
+
+@register("L2Loss")
+def _l2loss(ctx, node, inputs):
+    x = jnp.asarray(inputs[0])
+    return jnp.sum(jnp.square(x)) / 2
+
+
+# ---------------------------------------------------------------------------
+# shape / layout
+# ---------------------------------------------------------------------------
+
+
+@register("Shape")
+def _shape(ctx, node, inputs):
+    # Static under XLA even for traced inputs: shapes are compile-time facts.
+    out_t = node.attr("out_type", ScalarType.int32)
+    return np.asarray(jnp.shape(inputs[0]), dtype=out_t.np_dtype)
+
+
+@register("ShapeN")
+def _shape_n(ctx, node, inputs):
+    out_t = node.attr("out_type", ScalarType.int32)
+    return tuple(np.asarray(jnp.shape(x), dtype=out_t.np_dtype) for x in inputs)
+
+
+@register("Size")
+def _size(ctx, node, inputs):
+    out_t = node.attr("out_type", ScalarType.int32)
+    return np.asarray(jnp.size(inputs[0]), dtype=out_t.np_dtype)
+
+
+@register("Rank")
+def _rank(ctx, node, inputs):
+    return np.asarray(jnp.ndim(inputs[0]), dtype=np.int32)
+
+
+@register("Reshape")
+def _reshape(ctx, node, inputs):
+    target = ctx.static_int_list(inputs[1], node, "shape")
+    return jnp.reshape(inputs[0], target)
+
+
+@register("ExpandDims")
+def _expand_dims(ctx, node, inputs):
+    axis = int(ctx.static(inputs[1], node, "dim"))
+    return jnp.expand_dims(inputs[0], axis)
+
+
+@register("Squeeze")
+def _squeeze(ctx, node, inputs):
+    dims = node.attr("squeeze_dims", node.attr("axis", None))
+    if dims is not None and getattr(dims, "i", None) is not None:
+        dims = list(dims.i)
+    axes = tuple(dims) if dims else None
+    return jnp.squeeze(inputs[0], axis=axes)
+
+
+@register("Transpose")
+def _transpose(ctx, node, inputs):
+    perm = ctx.static_int_list(inputs[1], node, "perm")
+    return jnp.transpose(inputs[0], perm)
+
+
+@register("Fill")
+def _fill(ctx, node, inputs):
+    dims = ctx.static_int_list(inputs[0], node, "dims")
+    return jnp.full(dims, inputs[1])
+
+
+@register("Range")
+def _range(ctx, node, inputs):
+    start = ctx.static(inputs[0], node, "start")
+    limit = ctx.static(inputs[1], node, "limit")
+    delta = ctx.static(inputs[2], node, "delta")
+    return np.arange(start, limit, delta)
+
+
+@register("Tile")
+def _tile(ctx, node, inputs):
+    multiples = ctx.static_int_list(inputs[1], node, "multiples")
+    return jnp.tile(inputs[0], multiples)
+
+
+@register("Concat")
+def _concat(ctx, node, inputs):
+    axis = int(ctx.static(inputs[0], node, "concat_dim"))
+    return jnp.concatenate([jnp.asarray(x) for x in inputs[1:]], axis=axis)
+
+
+@register("ConcatV2")
+def _concat_v2(ctx, node, inputs):
+    axis = int(ctx.static(inputs[-1], node, "axis"))
+    return jnp.concatenate([jnp.asarray(x) for x in inputs[:-1]], axis=axis)
+
+
+@register("Pack")
+def _pack(ctx, node, inputs):
+    return jnp.stack([jnp.asarray(x) for x in inputs], axis=int(node.attr("axis", 0)))
+
+
+@register("Unpack")
+def _unpack(ctx, node, inputs):
+    axis = int(node.attr("axis", 0))
+    num = int(node.attr("num", jnp.shape(inputs[0])[axis]))
+    parts = jnp.split(jnp.asarray(inputs[0]), num, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+@register("Split")
+def _split(ctx, node, inputs):
+    axis = int(ctx.static(inputs[0], node, "split_dim"))
+    num = int(node.attr("num_split", 1))
+    return tuple(jnp.split(jnp.asarray(inputs[1]), num, axis=axis))
+
+
+@register("Slice")
+def _slice(ctx, node, inputs):
+    begin = ctx.static_int_list(inputs[1], node, "begin")
+    size = ctx.static_int_list(inputs[2], node, "size")
+    x = jnp.asarray(inputs[0])
+    limits = [
+        b + (s if s != -1 else x.shape[i] - b)
+        for i, (b, s) in enumerate(zip(begin, size))
+    ]
+    return lax.slice(x, begin, limits)
+
+
+@register("StridedSlice")
+def _strided_slice(ctx, node, inputs):
+    x = jnp.asarray(inputs[0])
+    begin = ctx.static_int_list(inputs[1], node, "begin")
+    end = ctx.static_int_list(inputs[2], node, "end")
+    strides = ctx.static_int_list(inputs[3], node, "strides")
+    bm = int(node.attr("begin_mask", 0))
+    em = int(node.attr("end_mask", 0))
+    ellipsis_mask = int(node.attr("ellipsis_mask", 0))
+    new_axis_mask = int(node.attr("new_axis_mask", 0))
+    shrink_mask = int(node.attr("shrink_axis_mask", 0))
+    # Build a numpy-style index tuple; numpy slicing semantics match TF's
+    # StridedSlice spec, so delegate the heavy lifting.
+    idx: List[Any] = []
+    for i in range(len(begin)):
+        if ellipsis_mask & (1 << i):
+            idx.append(Ellipsis)
+        elif new_axis_mask & (1 << i):
+            idx.append(None)
+        elif shrink_mask & (1 << i):
+            idx.append(begin[i])
+        else:
+            b = None if bm & (1 << i) else begin[i]
+            e = None if em & (1 << i) else end[i]
+            idx.append(slice(b, e, strides[i]))
+    return x[tuple(idx)]
+
+
+@register("GatherV2", "Gather")
+def _gather(ctx, node, inputs):
+    axis = int(ctx.static(inputs[2], node, "axis")) if len(inputs) > 2 else 0
+    return jnp.take(jnp.asarray(inputs[0]), jnp.asarray(inputs[1]), axis=axis)
+
+
+@register("OneHot")
+def _one_hot(ctx, node, inputs):
+    depth = int(ctx.static(inputs[1], node, "depth"))
+    on = inputs[2] if len(inputs) > 2 else 1.0
+    off = inputs[3] if len(inputs) > 3 else 0.0
+    axis = int(node.attr("axis", -1))
+    oh = jax.nn.one_hot(jnp.asarray(inputs[0]), depth, axis=axis)
+    return oh * on + (1 - oh) * off
+
+
+@register("Cast")
+def _cast(ctx, node, inputs):
+    dst = node.attr("DstT")
+    if dst is None:
+        raise GraphLoweringError(f"Cast {node.name!r} missing DstT")
+    return jnp.asarray(inputs[0]).astype(dst.np_dtype)
+
+
+@register("BroadcastTo")
+def _broadcast_to(ctx, node, inputs):
+    target = ctx.static_int_list(inputs[1], node, "shape")
+    return jnp.broadcast_to(inputs[0], target)
+
+
+# ---------------------------------------------------------------------------
+# NN ops (Inception / MLP family) — NHWC on the MXU via lax conv/reduce_window
+# ---------------------------------------------------------------------------
+
+
+@register("BiasAdd")
+def _bias_add(ctx, node, inputs):
+    x, b = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    if _data_format(node) == "NCHW" and x.ndim == 4:
+        return x + b.reshape(1, -1, 1, 1)
+    return x + b
+
+
+@register("Softmax")
+def _softmax(ctx, node, inputs):
+    return jax.nn.softmax(jnp.asarray(inputs[0]), axis=-1)
+
+
+@register("LogSoftmax")
+def _log_softmax(ctx, node, inputs):
+    return jax.nn.log_softmax(jnp.asarray(inputs[0]), axis=-1)
+
+
+@register("Conv2D")
+def _conv2d(ctx, node, inputs):
+    x, w = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    strides = [int(s) for s in node.attrs["strides"].value.i]
+    fmt = _data_format(node)
+    if fmt == "NHWC":
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NHWC", "HWIO", "NHWC"))
+        window_strides = strides[1:3]
+    else:
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "HWIO", "NCHW"))
+        window_strides = strides[2:4]
+    dil = node.attrs.get("dilations")
+    rhs_dilation = None
+    if dil is not None:
+        d = [int(v) for v in dil.value.i]
+        rhs_dilation = d[1:3] if fmt == "NHWC" else d[2:4]
+    return lax.conv_general_dilated(
+        x, w, window_strides, _padding_str(node),
+        rhs_dilation=rhs_dilation, dimension_numbers=dn,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+@register("DepthwiseConv2dNative")
+def _depthwise_conv(ctx, node, inputs):
+    x, w = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
+    strides = [int(s) for s in node.attrs["strides"].value.i]
+    # w: [H, W, C, M] -> depthwise = feature_group_count=C with [H,W,1,C*M]
+    h, wd, c, m = w.shape
+    w2 = jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (h, wd, 1, c * m))
+    dn = lax.conv_dimension_numbers(x.shape, w2.shape, ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x, w2, strides[1:3], _padding_str(node),
+        dimension_numbers=dn, feature_group_count=c,
+    )
+
+
+def _pool(ctx, node, inputs, init, op, avg=False):
+    x = jnp.asarray(inputs[0])
+    ksize = [int(k) for k in node.attrs["ksize"].value.i]
+    strides = [int(s) for s in node.attrs["strides"].value.i]
+    pad = _padding_str(node)
+    out = lax.reduce_window(x, init, op, ksize, strides, pad)
+    if avg:
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, ksize, strides, pad)
+        out = out / counts
+    return out
+
+
+@register("MaxPool", "MaxPoolV2")
+def _max_pool(ctx, node, inputs):
+    return _pool(ctx, node, inputs, -jnp.inf, lax.max)
+
+
+@register("AvgPool")
+def _avg_pool(ctx, node, inputs):
+    return _pool(ctx, node, inputs, 0.0, lax.add, avg=True)
+
+
+@register("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3")
+def _fused_batch_norm(ctx, node, inputs):
+    x, scale, offset, mean, var = (jnp.asarray(v) for v in inputs[:5])
+    eps = float(node.attr("epsilon", 1e-4))
+    if bool(node.attr("is_training", False)):
+        axes = (0, 1, 2) if _data_format(node) == "NHWC" else (0, 2, 3)
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+    if _data_format(node) == "NCHW":
+        shape = (1, -1, 1, 1)
+        scale, offset, mean, var = (v.reshape(shape) for v in (scale, offset, mean, var))
+    inv = scale * lax.rsqrt(var + eps)
+    y = (x - mean) * inv + offset
+    # TF returns (y, batch_mean, batch_var, ...); only y is commonly fetched.
+    return (y, jnp.ravel(mean), jnp.ravel(var))
+
+
+@register("BatchNormWithGlobalNormalization")
+def _batch_norm_global(ctx, node, inputs):
+    x, mean, var, beta, gamma = (jnp.asarray(v) for v in inputs[:5])
+    eps = float(node.attr("variance_epsilon", 1e-4))
+    inv = lax.rsqrt(var + eps)
+    if bool(node.attr("scale_after_normalization", True)):
+        inv = inv * gamma
+    return x * inv + (beta - mean * inv)
+
+
+@register("LRN")
+def _lrn(ctx, node, inputs):
+    x = jnp.asarray(inputs[0])
+    depth_radius = int(node.attr("depth_radius", 5))
+    bias = float(node.attr("bias", 1.0))
+    alpha = float(node.attr("alpha", 1.0))
+    beta = float(node.attr("beta", 0.5))
+    sq = jnp.square(x)
+    win = 2 * depth_radius + 1
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add, (1, 1, 1, win), (1, 1, 1, 1), "SAME"
+    )
+    return x / jnp.power(bias + alpha * summed, beta)

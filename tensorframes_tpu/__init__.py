@@ -24,6 +24,24 @@ _jax.config.update("jax_enable_x64", True)
 
 from .frame import Column, TensorFrame
 from .schema import ColumnInfo, FrameInfo, ScalarType, Shape, Unknown
+from .api import (
+    GroupedFrame,
+    aggregate,
+    analyze,
+    append_shape,
+    block,
+    explain,
+    group_by,
+    map_blocks,
+    map_rows,
+    print_schema,
+    reduce_blocks,
+    reduce_rows,
+    row,
+)
+from .graph import Graph, ShapeHints
+from .graph import builder as dsl
+from .runtime import Executor
 
 __all__ = [
     "Column",
@@ -33,4 +51,21 @@ __all__ = [
     "ScalarType",
     "Shape",
     "Unknown",
+    "GroupedFrame",
+    "aggregate",
+    "analyze",
+    "append_shape",
+    "block",
+    "explain",
+    "group_by",
+    "map_blocks",
+    "map_rows",
+    "print_schema",
+    "reduce_blocks",
+    "reduce_rows",
+    "row",
+    "Graph",
+    "ShapeHints",
+    "dsl",
+    "Executor",
 ]

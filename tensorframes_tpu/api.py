@@ -1,0 +1,827 @@
+"""The five execution verbs + schema utilities: the public API.
+
+TPU-native implementation of the reference's `OperationsInterface`
+(`Operations.scala:20-135`) and Python surface (`core.py`):
+
+- ``map_blocks(fetches, frame, trim=...)``   (`Operations.scala:43,59`)
+- ``map_rows(fetches, frame)``               (`Operations.scala:77`)
+- ``reduce_rows(fetches, frame)``            (`Operations.scala:96`)
+- ``reduce_blocks(fetches, frame)``          (`Operations.scala:108`)
+- ``aggregate(fetches, frame.group_by(k))``  (`Operations.scala:126`)
+- ``analyze`` / ``print_schema`` / ``append_shape`` (`ExperimentalOperations.scala`)
+- ``block`` / ``row`` placeholder helpers    (`core.py:451-474`)
+
+Graphs may be builder-DSL tensors, imported GraphDefs (bytes / file path /
+`Graph`), or plain Python functions over column arrays (the TPU-native
+tracer front-end — no GraphDef needed).
+
+Execution model vs the reference: instead of one native TF session per
+Spark partition (`performMap`, `DebugRowOps.scala:773-810`), each graph is
+jitted once into an XLA executable and applied per block; reductions stack
+per-block partials and run one combine step (the driver-funneled pairwise
+`RDD.reduce` at `DebugRowOps.scala:507,530` becomes a single on-device
+fold — distributed variants ride ICI collectives, see `parallel/`).
+
+Validation mirrors `SchemaTransforms` (`DebugRowOps.scala:80-272`): dtype
+equality (TF graphs don't promote), column shapes must be at least as
+precise as placeholder shapes (else the error points at `analyze`), and
+the reduce verbs enforce the reference's naming conventions
+(``x`` ↔ ``x_input`` for block reduces, ``x`` ↔ ``x_1``/``x_2`` for row
+reduces, `DebugRowOps.scala:80-262`).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax import lax
+
+from .frame import Column, TensorFrame
+from .graph import builder as dsl
+from .graph.analysis import GraphSummary, ShapeHints, analyze_graph
+from .graph.ir import Graph, parse_edge
+from .ops.lowering import build_callable
+from .runtime.executor import Executor, default_executor
+from .schema import Shape
+
+__all__ = [
+    "map_blocks",
+    "map_rows",
+    "reduce_blocks",
+    "reduce_rows",
+    "aggregate",
+    "analyze",
+    "print_schema",
+    "append_shape",
+    "block",
+    "row",
+    "group_by",
+    "GroupedFrame",
+    "explain",
+]
+
+Fetches = Union[dsl.Tensor, Sequence[dsl.Tensor], Graph, bytes, str, Callable]
+
+
+# ---------------------------------------------------------------------------
+# graph normalization
+# ---------------------------------------------------------------------------
+
+
+def _as_graph(
+    fetches: Fetches, fetch_names: Optional[Sequence[str]]
+) -> Tuple[Graph, List[str]]:
+    if isinstance(fetches, dsl.Tensor):
+        return dsl.build(fetches)
+    if isinstance(fetches, (list, tuple)) and all(
+        isinstance(f, dsl.Tensor) for f in fetches
+    ):
+        return dsl.build(list(fetches))
+    if isinstance(fetches, Graph):
+        g = fetches
+    elif isinstance(fetches, bytes):
+        g = Graph.from_bytes(fetches)
+    elif isinstance(fetches, str):
+        g = Graph.from_file(fetches)
+    else:
+        raise TypeError(f"cannot interpret fetches of type {type(fetches)!r}")
+    if not fetch_names:
+        raise ValueError(
+            "imported graphs need explicit fetch_names=[...] "
+            "(the reference's builder.fetches, PythonInterface.scala:105-108)"
+        )
+    return g, list(fetch_names)
+
+
+def _base(name: str) -> str:
+    return parse_edge(name)[0]
+
+
+# ---------------------------------------------------------------------------
+# placeholder <-> column matching + validation (SchemaTransforms)
+# ---------------------------------------------------------------------------
+
+_REDUCE_SUFFIXES = ("_input", "_1", "_2")
+
+
+def _default_column(ph_name: str, frame: TensorFrame) -> str:
+    """Reference naming conventions: placeholder ``x_input``/``x_1``/``x_2``
+    reads column ``x`` by default (`DebugRowOps.scala:80-262`). An exact
+    column-name match always wins — suffix stripping only kicks in when no
+    column carries the placeholder's literal name (so a column named
+    ``temp_1`` is not hijacked by the convention)."""
+    if ph_name in frame.info:
+        return ph_name
+    for suf in _REDUCE_SUFFIXES:
+        if ph_name.endswith(suf):
+            candidate = ph_name[: -len(suf)]
+            if candidate in frame.info:
+                return candidate
+    return ph_name
+
+
+def _match_columns(
+    summary: GraphSummary,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]],
+    block_level: bool,
+) -> Dict[str, str]:
+    """Map placeholder name -> column name; validate dtype + shape precision."""
+    feed_dict = feed_dict or {}
+    mapping: Dict[str, str] = {}
+    for ph_name, ph in summary.inputs.items():
+        col_name = feed_dict.get(ph_name, _default_column(ph_name, frame))
+        if col_name not in frame.info:
+            raise ValueError(
+                f"placeholder {ph_name!r} wants column {col_name!r} which is "
+                f"not in the frame (columns: {frame.columns}); use feed_dict "
+                "to rename"
+            )
+        info = frame.info[col_name]
+        if info.dtype is not ph.dtype:
+            raise ValueError(
+                f"placeholder {ph_name!r} has dtype {ph.dtype.name} but "
+                f"column {col_name!r} has dtype {info.dtype.name} (TF graphs "
+                "do not promote dtypes)"
+            )
+        col_shape = info.block_shape if block_level else info.cell_shape
+        if not col_shape.check_more_precise_than(ph.shape):
+            raise ValueError(
+                f"column {col_name!r} with shape {col_shape} is not compatible"
+                f" with shape {ph.shape} requested by placeholder {ph_name!r}."
+                " If the column shape has unknown dims, run tfs.analyze(frame)"
+                " first (ExperimentalOperations.analyze)"
+            )
+        mapping[ph_name] = col_name
+    return mapping
+
+
+def _require_dense(frame: TensorFrame, cols: Sequence[str], verb: str) -> None:
+    for c in cols:
+        if not frame.column(c).is_dense:
+            raise ValueError(
+                f"{verb}: column {c!r} is ragged (rows have varying shapes); "
+                "block-level ops need uniform cells — use map_rows, or fix "
+                "the data"
+            )
+
+
+def _ph_overrides(
+    summary_graph: Graph,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]],
+    block_level: bool,
+) -> Dict[str, Shape]:
+    """Column shapes are usually *more* precise than placeholder attrs
+    (e.g. imported graphs carry [?,?]); inject them for tighter analysis,
+    mirroring how `block()` stamps column shapes onto placeholders
+    (`DslImpl.scala:90-107`)."""
+    feed_dict = feed_dict or {}
+    overrides: Dict[str, Shape] = {}
+    for ph in summary_graph.placeholders():
+        col_name = feed_dict.get(ph.name, _default_column(ph.name, frame))
+        if col_name in frame.info:
+            info = frame.info[col_name]
+            shape = info.block_shape if block_level else info.cell_shape
+            attr = ph.shape_attr
+            if attr is None or shape.check_more_precise_than(attr):
+                overrides[ph.name] = shape
+    return overrides
+
+
+# ---------------------------------------------------------------------------
+# output frame assembly
+# ---------------------------------------------------------------------------
+
+
+def _output_frame(
+    frame: TensorFrame,
+    out_cols: List[Column],
+    append_input: bool,
+    offsets: Optional[List[int]] = None,
+) -> TensorFrame:
+    """TF output columns first, sorted by name, then passthrough input
+    columns (`DebugRowOps.scala:355,375-379`). On a name collision the graph
+    output wins (the frame analogue of SQL duplicate columns)."""
+    out_cols = sorted(out_cols, key=lambda c: c.name)
+    cols = list(out_cols)
+    if append_input:
+        shadow = {c.name for c in out_cols}
+        cols += [frame.column(n) for n in frame.columns if n not in shadow]
+    return TensorFrame(cols, offsets if offsets is not None else frame.offsets)
+
+
+# ---------------------------------------------------------------------------
+# function front-end: trace a Python fn over named column arrays
+# ---------------------------------------------------------------------------
+
+
+def _fn_feed_columns(fn: Callable, frame: TensorFrame) -> List[str]:
+    params = [
+        p.name
+        for p in inspect.signature(fn).parameters.values()
+        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+    ]
+    missing = [p for p in params if p not in frame.info]
+    if missing:
+        raise ValueError(
+            f"function front-end: parameters {missing} have no matching "
+            f"columns (columns: {frame.columns})"
+        )
+    return params
+
+
+def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
+    if isinstance(res, dict):
+        return res
+    raise ValueError(
+        f"{what}: a function graph must return a dict of named output "
+        "arrays (output names become column names)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# map_blocks
+# ---------------------------------------------------------------------------
+
+
+def map_blocks(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    trim: bool = False,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+) -> TensorFrame:
+    """Apply a graph to each block; one jitted XLA call per block.
+
+    `DebugRowOps.mapBlocks` (`DebugRowOps.scala:290-400`). With
+    ``trim=True`` the row count may change and input columns are dropped
+    (`Operations.scala:59-76`).
+    """
+    ex = executor or default_executor()
+    if callable(fetches) and not isinstance(fetches, dsl.Tensor):
+        return _map_blocks_fn(fetches, frame, trim, ex)
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _require_dense(frame, list(mapping.values()), "map_blocks")
+
+    feed_names = sorted(summary.inputs)
+    fn = ex.callable_for(graph, fetch_list, feed_names)
+
+    acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
+    out_sizes: List[int] = []
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo == hi:
+            out_sizes.append(0)
+            continue  # empty block: contributes nothing (the reference's
+            # empty-partition TODO, `DebugRowOps.scala:386-387`)
+        feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
+        outs = fn(*feeds)
+        bsize = None
+        for f, o in zip(fetch_list, outs):
+            o = np.asarray(o)
+            if not trim and (o.ndim == 0 or o.shape[0] != hi - lo):
+                raise ValueError(
+                    f"map_blocks: output {f!r} has lead dim "
+                    f"{o.shape[0] if o.ndim else '<scalar>'} but the block "
+                    f"has {hi - lo} rows; use trim=True for row-count-"
+                    "changing maps"
+                )
+            if trim:
+                if o.ndim == 0:
+                    raise ValueError(
+                        f"map_blocks(trim): output {f!r} must have a lead dim"
+                    )
+                if bsize is None:
+                    bsize = o.shape[0]
+                elif o.shape[0] != bsize:
+                    raise ValueError(
+                        "map_blocks(trim): outputs disagree on row count"
+                    )
+            acc[_base(f)].append(o)
+        out_sizes.append(bsize if trim else hi - lo)
+
+    out_cols = []
+    for f in fetch_list:
+        base = _base(f)
+        parts = acc[base]
+        data = (
+            np.concatenate(parts)
+            if parts
+            else np.zeros((0,) + tuple(summary.outputs[base].shape.dims[1:] or ()))
+        )
+        out_cols.append(Column(base, data))
+    offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
+    return _output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
+
+
+def _map_blocks_fn(
+    fn: Callable, frame: TensorFrame, trim: bool, ex: Executor
+) -> TensorFrame:
+    params = _fn_feed_columns(fn, frame)
+    _require_dense(frame, params, "map_blocks")
+    jfn = jax.jit(lambda *args: _fn_outputs_to_dict(fn(*args), "map_blocks"))
+    acc: Dict[str, List[np.ndarray]] = {}
+    out_sizes: List[int] = []
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo == hi:
+            out_sizes.append(0)
+            continue
+        outs = jfn(*[frame.column(p).values[lo:hi] for p in params])
+        bsize = None
+        for name, o in outs.items():
+            o = np.asarray(o)
+            if o.ndim == 0:
+                raise ValueError(
+                    f"map_blocks: output {name!r} must have a lead (row) dim"
+                    + ("" if trim else "; use trim=True for reductions")
+                )
+            if not trim and o.shape[0] != hi - lo:
+                raise ValueError(
+                    f"map_blocks: output {name!r} does not preserve the "
+                    "block row count; use trim=True"
+                )
+            if trim:
+                if bsize is None:
+                    bsize = o.shape[0]
+                elif o.shape[0] != bsize:
+                    raise ValueError(
+                        "map_blocks(trim): outputs disagree on row count"
+                    )
+            acc.setdefault(name, []).append(o)
+        out_sizes.append(bsize if trim else hi - lo)
+    out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+    offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
+    return _output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
+
+
+# ---------------------------------------------------------------------------
+# map_rows
+# ---------------------------------------------------------------------------
+
+
+def map_rows(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+) -> TensorFrame:
+    """Apply a graph independently to every row.
+
+    `DebugRowOps.mapRows` (`DebugRowOps.scala:403-484`). Dense columns take
+    the vmap fast path: the per-row graph is vectorized over the block and
+    runs as ONE XLA call per block — versus the reference's one session.run
+    per row (`performMapRows`, `DebugRowOps.scala:826-864`). Ragged columns
+    fall back to a per-row loop (compile-cached per distinct cell shape),
+    the moral equivalent of the reference's variable-length row support
+    (`TFDataOps.scala:90-103`).
+    """
+    ex = executor or default_executor()
+    if callable(fetches) and not isinstance(fetches, dsl.Tensor):
+        return _map_rows_fn(fetches, frame)
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=False)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=False)
+    params = sorted(summary.inputs)
+    cols_used = [mapping[p] for p in params]
+    out_names = [_base(f) for f in fetch_list]
+    dense = all(frame.column(c).is_dense for c in cols_used)
+
+    if dense:
+        vfn = ex.cached(
+            "vmap-rows",
+            graph,
+            fetch_list,
+            params,
+            lambda: jax.jit(
+                jax.vmap(build_callable(graph, fetch_list, params))
+            ),
+        )
+        acc: Dict[str, List[np.ndarray]] = {n: [] for n in out_names}
+        for bi in range(frame.num_blocks):
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            if lo == hi:
+                continue
+            outs = vfn(*[frame.column(c).values[lo:hi] for c in cols_used])
+            for n, o in zip(out_names, outs):
+                acc[n].append(np.asarray(o))
+        out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+    else:
+        jrow = ex.cached(
+            "row",
+            graph,
+            fetch_list,
+            params,
+            lambda: jax.jit(build_callable(graph, fetch_list, params)),
+        )
+        per_out: Dict[str, List[np.ndarray]] = {n: [] for n in out_names}
+        for i in range(frame.nrows):
+            cells = [np.asarray(frame.column(c).row(i)) for c in cols_used]
+            outs = jrow(*cells)
+            for n, o in zip(out_names, outs):
+                per_out[n].append(np.asarray(o))
+        out_cols = [Column(n, vals) for n, vals in per_out.items()]
+
+    return _output_frame(frame, out_cols, append_input=True)
+
+
+def _map_rows_fn(fn: Callable, frame: TensorFrame) -> TensorFrame:
+    """Function front-end for map_rows: fn(cell, ...) -> dict of outputs.
+
+    jit/vmap preserve dict outputs, so output names come from the traced
+    dict directly — the user function is invoked exactly once per trace.
+    """
+    params = _fn_feed_columns(fn, frame)
+    dense = all(frame.column(p).is_dense for p in params)
+
+    def wrapped(*cells):
+        return _fn_outputs_to_dict(fn(*cells), "map_rows")
+
+    acc: Dict[str, List[np.ndarray]] = {}
+    if dense:
+        vfn = jax.jit(jax.vmap(wrapped))
+        for bi in range(frame.num_blocks):
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            if lo == hi:
+                continue
+            outs = vfn(*[frame.column(p).values[lo:hi] for p in params])
+            for n, o in outs.items():
+                acc.setdefault(n, []).append(np.asarray(o))
+        out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+    else:
+        jrow = jax.jit(wrapped)
+        for i in range(frame.nrows):
+            outs = jrow(*[np.asarray(frame.column(p).row(i)) for p in params])
+            for n, o in outs.items():
+                acc.setdefault(n, []).append(np.asarray(o))
+        out_cols = [Column(n, vals) for n, vals in acc.items()]
+    return _output_frame(frame, out_cols, append_input=True)
+
+
+# ---------------------------------------------------------------------------
+# reduce_blocks
+# ---------------------------------------------------------------------------
+
+
+def _validate_reduce_blocks(
+    summary: GraphSummary, fetch_list: List[str]
+) -> None:
+    """`reduceBlocksSchema` naming + shape contract
+    (`DebugRowOps.scala:80-170`): output ``x`` ↔ placeholder ``x_input``,
+    same dtype, placeholder = output shape + unknown lead dim."""
+    allowed = {_base(f) + "_input" for f in fetch_list}
+    extra = set(summary.inputs) - allowed
+    if extra:
+        raise ValueError(
+            f"reduce_blocks: placeholders {sorted(extra)} do not follow the "
+            f"x -> x_input convention for outputs {sorted(allowed)} "
+            "(every input must be re-fed a partial during the combine step)"
+        )
+    for f in fetch_list:
+        base = _base(f)
+        ph_name = base + "_input"
+        if ph_name not in summary.inputs:
+            raise ValueError(
+                f"reduce_blocks: output {base!r} requires a placeholder "
+                f"named {ph_name!r} (inputs: {sorted(summary.inputs)})"
+            )
+        ph = summary.inputs[ph_name]
+        out = summary.outputs[base]
+        if ph.dtype is not out.dtype:
+            raise ValueError(
+                f"reduce_blocks: {base!r} has dtype {out.dtype.name} but "
+                f"{ph_name!r} has dtype {ph.dtype.name}"
+            )
+        if ph.shape.rank != out.shape.rank + 1:
+            raise ValueError(
+                f"reduce_blocks: placeholder {ph_name!r} (shape {ph.shape}) "
+                f"must be output {base!r} (shape {out.shape}) plus a lead "
+                "block dim"
+            )
+        if not out.shape.check_more_precise_than(ph.shape.tail):
+            raise ValueError(
+                f"reduce_blocks: output {base!r} shape {out.shape} does not "
+                f"match placeholder cell shape {ph.shape.tail}; partials "
+                "must be re-feedable for the combine step"
+            )
+
+
+def reduce_blocks(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+):
+    """Per-block reduce, then one on-device combine over stacked partials.
+
+    `DebugRowOps.reduceBlocks` (`DebugRowOps.scala:510-533`). The reference
+    funnels partials to the driver and merges PAIRWISE, each pair a fresh
+    session on a 2-row block (`reducePairBlock`, `:748-757`); since the
+    contract already demands associativity (Spark `RDD.reduce`), we stack
+    all partials into one (num_blocks)-row block and run the same graph
+    once. Returns a single array for one fetch, a dict for several
+    (`_unpack_row`, `core.py:111-125`).
+    """
+    ex = executor or default_executor()
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    _validate_reduce_blocks(summary, fetch_list)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _require_dense(frame, list(mapping.values()), "reduce_blocks")
+
+    feed_names = sorted(summary.inputs)
+    fn = ex.callable_for(graph, fetch_list, feed_names)
+
+    partials: List[Tuple] = []
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo == hi:
+            continue
+        outs = fn(*[frame.column(mapping[n]).values[lo:hi] for n in feed_names])
+        partials.append(tuple(np.asarray(o) for o in outs))
+    if not partials:
+        raise ValueError("reduce_blocks on an empty frame")
+    if len(partials) == 1:
+        final = partials[0]
+    else:
+        stacked = {
+            _base(f) + "_input": np.stack([p[i] for p in partials])
+            for i, f in enumerate(fetch_list)
+        }
+        final = fn(*[stacked[n] for n in feed_names])
+        final = tuple(np.asarray(o) for o in final)
+    if len(fetch_list) == 1:
+        return final[0]
+    return {_base(f): v for f, v in zip(fetch_list, final)}
+
+
+# ---------------------------------------------------------------------------
+# reduce_rows
+# ---------------------------------------------------------------------------
+
+
+def _validate_reduce_rows(summary: GraphSummary, fetch_list: List[str]) -> None:
+    """`reduceRowsSchema` (`DebugRowOps.scala:172-262`): output ``x`` ↔
+    placeholders ``x_1``/``x_2``, all three the same dtype and cell shape."""
+    allowed = {_base(f) + s for f in fetch_list for s in ("_1", "_2")}
+    extra = set(summary.inputs) - allowed
+    if extra:
+        raise ValueError(
+            f"reduce_rows: placeholders {sorted(extra)} do not follow the "
+            "x -> x_1/x_2 convention"
+        )
+    for f in fetch_list:
+        base = _base(f)
+        for suf in ("_1", "_2"):
+            if base + suf not in summary.inputs:
+                raise ValueError(
+                    f"reduce_rows: output {base!r} requires placeholders "
+                    f"{base}_1 and {base}_2 (inputs: {sorted(summary.inputs)})"
+                )
+        p1, p2 = summary.inputs[base + "_1"], summary.inputs[base + "_2"]
+        out = summary.outputs[base]
+        if not (p1.dtype is p2.dtype is out.dtype):
+            raise ValueError(f"reduce_rows: dtype mismatch around {base!r}")
+        if not (
+            out.shape.check_more_precise_than(p1.shape)
+            and out.shape.check_more_precise_than(p2.shape)
+        ):
+            raise ValueError(
+                f"reduce_rows: shapes around {base!r} must all agree "
+                f"(out {out.shape}, {base}_1 {p1.shape}, {base}_2 {p2.shape})"
+            )
+
+
+def reduce_rows(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+):
+    """Pairwise fold over all rows.
+
+    `DebugRowOps.reduceRows` (`DebugRowOps.scala:486-508`): the reference
+    folds each partition sequentially with one session.run PER ROW PAIR
+    (`performReducePairwise`, `:939-979`). Here the pair graph is rolled
+    into a `lax.scan` and the whole per-block fold is ONE XLA call; block
+    partials then fold the same way. Fold order matches the reference
+    (left fold in row order), so non-associative graphs agree too.
+    """
+    ex = executor or default_executor()
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=False)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    _validate_reduce_rows(summary, fetch_list)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=False)
+    _require_dense(frame, list(mapping.values()), "reduce_rows")
+
+    bases = [_base(f) for f in fetch_list]
+    for b in bases:
+        c1, c2 = mapping[b + "_1"], mapping[b + "_2"]
+        if c1 != c2:
+            raise ValueError(
+                f"reduce_rows: {b}_1 reads column {c1!r} but {b}_2 reads "
+                f"{c2!r}; a fold's carry and next-row must come from the "
+                "same column"
+            )
+    feed_names = [b + s for b in bases for s in ("_1", "_2")]
+
+    def make_fold():
+        pair = build_callable(graph, fetch_list, feed_names)
+
+        def fold(cols: Dict[str, "jax.Array"]):
+            carry0 = tuple(cols[b][0] for b in bases)
+            xs = tuple(cols[b][1:] for b in bases)
+
+            def step(carry, xrow):
+                feeds = []
+                for i, _ in enumerate(bases):
+                    feeds.extend((carry[i], xrow[i]))
+                return tuple(pair(*feeds)), None
+
+            carry, _ = lax.scan(step, carry0, xs)
+            return carry
+
+        return jax.jit(fold)
+
+    jfold = ex.cached("fold", graph, fetch_list, feed_names, make_fold)
+    partials: List[Tuple[np.ndarray, ...]] = []
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo == hi:
+            continue
+        cols = {b: frame.column(mapping[b + "_1"]).values[lo:hi] for b in bases}
+        if hi - lo == 1:
+            partials.append(tuple(np.asarray(cols[b][0]) for b in bases))
+        else:
+            outs = jfold(cols)
+            partials.append(tuple(np.asarray(o) for o in outs))
+    if not partials:
+        raise ValueError("reduce_rows on an empty frame")
+    if len(partials) == 1:
+        final = partials[0]
+    else:
+        stacked = {
+            b: np.stack([p[i] for p in partials]) for i, b in enumerate(bases)
+        }
+        final = tuple(np.asarray(o) for o in jfold(stacked))
+    if len(bases) == 1:
+        return final[0]
+    return dict(zip(bases, final))
+
+
+# ---------------------------------------------------------------------------
+# aggregate (keyed)
+# ---------------------------------------------------------------------------
+
+
+class GroupedFrame:
+    """`frame.group_by(keys)` — the RelationalGroupedDataset analogue."""
+
+    def __init__(self, frame: TensorFrame, keys: Sequence[str]):
+        self.frame = frame
+        self.keys = list(keys)
+        for k in self.keys:
+            info = frame.info[k]
+            if not info.cell_shape.is_scalar:
+                raise ValueError(f"group key {k!r} must be a scalar column")
+            if not frame.column(k).is_dense:
+                raise ValueError(f"group key {k!r} must be dense")
+
+
+def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
+    return GroupedFrame(frame, keys)
+
+
+def aggregate(
+    fetches: Fetches,
+    grouped: GroupedFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+) -> TensorFrame:
+    """Keyed aggregation with reduce_blocks naming conventions.
+
+    `DebugRowOps.aggregate` (`DebugRowOps.scala:554-599`). The reference
+    buffers up to 10 rows per group in a Catalyst UDAF and repeatedly
+    compacts with a fresh TF session (`TensorFlowUDAF`, `:608-702`). Here
+    rows are sorted by key once, and groups OF THE SAME SIZE are stacked
+    and vmapped — one XLA call per distinct group size, each batched over
+    all groups of that size.
+    """
+    ex = executor or default_executor()
+    frame = grouped.frame
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    _validate_reduce_blocks(summary, fetch_list)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _require_dense(frame, list(mapping.values()), "aggregate")
+
+    # --- factorize keys (host; the Catalyst shuffle analogue) ----------
+    key_arrays = [frame.column(k).values for k in grouped.keys]
+    if len(key_arrays) == 1:
+        uniq, inverse = np.unique(key_arrays[0], return_inverse=True)
+        key_out = {grouped.keys[0]: uniq}
+    else:
+        stacked_keys = np.stack(
+            [np.asarray(a).astype(object, copy=False) for a in key_arrays], 1
+        )
+        _, first_idx, inverse = np.unique(
+            np.array([tuple(r) for r in stacked_keys], dtype=object),
+            return_index=True,
+            return_inverse=True,
+        )
+        key_out = {
+            k: key_arrays[i][first_idx] for i, k in enumerate(grouped.keys)
+        }
+    num_groups = len(next(iter(key_out.values())))
+    order = np.argsort(inverse, kind="stable")
+    sorted_gid = inverse[order]
+    counts = np.bincount(inverse, minlength=num_groups)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+    feed_names = sorted(summary.inputs)
+    vraw = ex.cached(
+        "vmap-agg",
+        graph,
+        fetch_list,
+        feed_names,
+        lambda: jax.jit(
+            jax.vmap(build_callable(graph, fetch_list, feed_names))
+        ),
+    )
+
+    bases = [_base(f) for f in fetch_list]
+    results: Dict[str, np.ndarray] = {}
+    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
+
+    out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+    for size in np.unique(counts):
+        gids = np.nonzero(counts == size)[0]
+        if size == 0:
+            continue
+        row_idx = starts[gids][:, None] + np.arange(size)[None, :]
+        feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
+        outs = vraw(*feeds)
+        for b, o in zip(bases, outs):
+            o = np.asarray(o)
+            if out_buffers[b] is None:
+                out_buffers[b] = np.zeros((num_groups,) + o.shape[1:], o.dtype)
+            out_buffers[b][gids] = o
+    for b in bases:
+        results[b] = out_buffers[b]
+
+    cols = [Column(k, v) for k, v in key_out.items()]
+    cols += [Column(b, results[b]) for b in sorted(bases)]
+    return TensorFrame(cols)
+
+
+# ---------------------------------------------------------------------------
+# schema utilities
+# ---------------------------------------------------------------------------
+
+
+def analyze(frame: TensorFrame) -> TensorFrame:
+    """Scan the data and refine column shapes (`ExperimentalOperations.analyze`)."""
+    return frame.analyze()
+
+
+def print_schema(frame: TensorFrame) -> None:
+    """`tfs.print_schema` (`core.py:355-364`)."""
+    frame.print_schema()
+
+
+def append_shape(frame: TensorFrame, col: str, shape) -> TensorFrame:
+    """`tfs.append_shape` (`ExperimentalOperations.scala:53-68`)."""
+    if not isinstance(shape, Shape):
+        shape = Shape(shape)
+    return frame.append_shape(col, shape)
+
+
+def explain(frame: TensorFrame) -> str:
+    """`OperationsInterface.explain` (`DebugRowOps.scala:535-552`)."""
+    return frame.info.explain()
+
+
+def block(frame: TensorFrame, col_name: str, tf_name: Optional[str] = None):
+    """Block placeholder for a column (`core.py:451-474`, `tfs.block`)."""
+    return dsl.block(frame, col_name, tf_name)
+
+
+def row(frame: TensorFrame, col_name: str, tf_name: Optional[str] = None):
+    """Row placeholder for a column (`tfs.row`)."""
+    return dsl.row(frame, col_name, tf_name)

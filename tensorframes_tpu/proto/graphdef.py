@@ -179,7 +179,8 @@ class TensorProto:
 
     @classmethod
     def from_numpy(cls, arr: np.ndarray) -> "TensorProto":
-        arr = np.ascontiguousarray(arr)
+        # NB: np.ascontiguousarray would promote 0-d arrays to 1-d.
+        arr = np.asarray(arr, order="C")
         dtype = ScalarType.from_np_dtype(arr.dtype)
         if dtype is ScalarType.string:
             flat = [

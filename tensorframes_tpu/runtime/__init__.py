@@ -1,0 +1,5 @@
+"""Runtime layer: compile-cached execution."""
+
+from .executor import Executor, default_executor
+
+__all__ = ["Executor", "default_executor"]

@@ -1,16 +1,25 @@
-"""Test fixture: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test fixture: force an 8-device virtual CPU mesh before any backend
+initializes.
 
 Mirrors the reference's test strategy of simulating the cluster locally
 (`local[1]` SparkContext with 4 shuffle partitions,
-`TensorFlossTestSparkContext.scala:14-22`): multi-chip behavior is tested on
+`TensorFlossTestSparkContext.scala:14-22`): multi-chip behavior runs on
 virtual CPU devices; the real chip is exercised by `bench.py`.
+
+Note: the environment may pre-register a TPU backend and override
+``jax_platforms`` at interpreter start (sitecustomize), so setting the
+JAX_PLATFORMS env var is not enough — we update the config directly, which
+wins as long as no backend has been initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

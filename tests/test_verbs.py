@@ -1,0 +1,380 @@
+"""The five verbs — integration tests through the public API.
+
+Mirrors the reference's `BasicOperationsSuite` (identity/add/reduce across
+ranks 0-2, multiple uneven partitions), `TrimmingOperationsSuite`
+(row-count-changing maps), and `core_test.py` (feed_dict renames, groupby,
+map/reduce round-trips)."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.schema import ScalarType, Shape
+
+
+def frame_of(**cols):
+    return tfs.TensorFrame.from_dict(cols)
+
+
+class TestMapBlocks:
+    def test_readme_x_plus_3(self):
+        # The README flagship example.
+        df = tfs.TensorFrame.from_dict({"x": np.array([1.0, 2.0, 3.0])})
+        x = tfs.block(df, "x")
+        z = (x + 3.0).named("z")
+        out = tfs.map_blocks(z, df)
+        assert out.columns == ["z", "x"]  # TF cols first, then passthrough
+        np.testing.assert_array_equal(out["z"].values, [4.0, 5.0, 6.0])
+        np.testing.assert_array_equal(out["x"].values, [1.0, 2.0, 3.0])
+
+    def test_identity_rank1(self):
+        df = frame_of(x=np.ones((4, 3)))
+        x = tfs.block(df, "x")
+        y = dsl.identity(x).named("y")
+        out = tfs.map_blocks(y, df)
+        np.testing.assert_array_equal(out["y"].values, np.ones((4, 3)))
+
+    def test_multiple_blocks_uneven(self):
+        # BasicOperationsSuite.scala:219-227 (explicit uneven partitions).
+        df = tfs.TensorFrame.from_dict({"x": np.arange(7.0)}, num_blocks=3)
+        x = tfs.block(df, "x")
+        out = tfs.map_blocks((x * 2.0).named("y"), df)
+        np.testing.assert_array_equal(out["y"].values, 2 * np.arange(7.0))
+        assert out.num_blocks == 3
+
+    def test_block_reduction_inside_map(self):
+        # A graph may reduce over the block dim (k-means pattern): each
+        # block sees its own lead dim, like each Spark partition did.
+        df = tfs.TensorFrame.from_dict({"x": np.arange(6.0)}, num_blocks=2)
+        x = tfs.block(df, "x")
+        s = dsl.reduce_sum(x, axes=[0], keep_dims=True)
+        centered = (x - s / 3.0).named("c")  # block mean with 3 rows/block
+        out = tfs.map_blocks(centered, df)
+        np.testing.assert_array_equal(
+            out["c"].values, np.array([-1, 0, 1, -1, 0, 1.0])
+        )
+
+    def test_feed_dict_rename(self):
+        # core_test.py feed renames: placeholder name != column name.
+        df = frame_of(y=np.array([1.0, 2.0]))
+        x = dsl.placeholder(ScalarType.float64, Shape((None,)), name="x")
+        out = tfs.map_blocks((x + 1.0).named("z"), df, feed_dict={"x": "y"})
+        np.testing.assert_array_equal(out["z"].values, [2.0, 3.0])
+
+    def test_trimmed_map(self):
+        # TrimmingOperationsSuite: row count may change; inputs dropped.
+        df = frame_of(x=np.arange(6.0))
+        x = tfs.block(df, "x")
+        s = dsl.reduce_sum(x, axes=[0], keep_dims=True).named("s")
+        out = tfs.map_blocks(s, df, trim=True)
+        assert out.columns == ["s"]
+        assert out.nrows == 1
+        np.testing.assert_array_equal(out["s"].values, [15.0])
+
+    def test_missing_trim_raises(self):
+        df = frame_of(x=np.arange(6.0))
+        x = tfs.block(df, "x")
+        s = dsl.reduce_sum(x, axes=[0], keep_dims=True).named("s")
+        with pytest.raises(ValueError, match="trim"):
+            tfs.map_blocks(s, df)
+
+    def test_dtype_mismatch(self):
+        df = frame_of(x=np.arange(3, dtype=np.int32))
+        ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="x")
+        with pytest.raises(ValueError, match="dtype"):
+            tfs.map_blocks((ph + 1.0).named("z"), df)
+
+    def test_missing_column(self):
+        df = frame_of(x=np.arange(3.0))
+        ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="nope")
+        with pytest.raises(ValueError, match="not in the frame"):
+            tfs.map_blocks((ph + 1.0).named("z"), df)
+
+    def test_shape_incompat(self):
+        df = frame_of(x=np.ones((3, 2)))
+        ph = dsl.placeholder(ScalarType.float64, Shape((None, 5)), name="x")
+        with pytest.raises(ValueError, match="not compatible"):
+            tfs.map_blocks((ph + 1.0).named("z"), df)
+
+    def test_two_outputs_sorted(self):
+        df = frame_of(x=np.arange(3.0))
+        x = tfs.block(df, "x")
+        b = (x + 1.0).named("b")
+        a = (x * 2.0).named("a")
+        out = tfs.map_blocks([b, a], df)
+        assert out.columns == ["a", "b", "x"]
+
+    def test_function_frontend(self):
+        # TPU-native path: a plain function over column arrays.
+        df = frame_of(x=np.arange(4.0), y=np.ones(4))
+        out = tfs.map_blocks(lambda x, y: {"z": x * y + 1.0}, df)
+        np.testing.assert_array_equal(out["z"].values, np.arange(4.0) + 1.0)
+        assert out.columns == ["z", "x", "y"]
+
+    def test_vector_block(self):
+        df = frame_of(v=np.arange(12.0).reshape(4, 3))
+        v = tfs.block(df, "v")
+        out = tfs.map_blocks((v * 2.0).named("w"), df)
+        np.testing.assert_array_equal(out["w"].values, 2 * df["v"].values)
+
+
+class TestMapRows:
+    def test_scalar_rows(self):
+        df = frame_of(x=np.arange(4.0))
+        x = tfs.row(df, "x")
+        out = tfs.map_rows((x + 1.0).named("y"), df)
+        np.testing.assert_array_equal(out["y"].values, np.arange(4.0) + 1)
+
+    def test_vector_rows_vmapped(self):
+        df = frame_of(v=np.arange(8.0).reshape(4, 2))
+        v = tfs.row(df, "v")
+        s = dsl.reduce_sum(v, axes=[0]).named("s")
+        out = tfs.map_rows(s, df)
+        np.testing.assert_array_equal(out["s"].values, df["v"].values.sum(1))
+
+    def test_ragged_rows(self):
+        # variable-length vectors per row (TFDataOps.scala:90-103)
+        df = tfs.TensorFrame.from_dict({"v": [np.arange(2.0), np.arange(5.0)]})
+        v = tfs.row(df, "v")
+        s = dsl.reduce_sum(v, axes=[0]).named("s")
+        out = tfs.map_rows(s, df)
+        np.testing.assert_array_equal(out["s"].values, [1.0, 10.0])
+
+    def test_ragged_output_column(self):
+        df = tfs.TensorFrame.from_dict({"v": [np.arange(2.0), np.arange(3.0)]})
+        v = tfs.row(df, "v")
+        out = tfs.map_rows((v * 2.0).named("w"), df)
+        assert not out["w"].is_dense
+        np.testing.assert_array_equal(out["w"].row(1), [0.0, 2.0, 4.0])
+
+    def test_function_frontend(self):
+        df = frame_of(x=np.arange(4.0))
+        out = tfs.map_rows(lambda x: {"y": x * x}, df)
+        np.testing.assert_array_equal(out["y"].values, np.arange(4.0) ** 2)
+
+
+class TestReduceBlocks:
+    def test_vector_sum(self):
+        # README vector reduce_sum — the BASELINE north-star config.
+        df = tfs.TensorFrame.from_dict({"x": np.arange(10.0)}, num_blocks=3)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        res = tfs.reduce_blocks(x, df)
+        assert float(res) == 45.0
+
+    def test_reduce_min(self):
+        df = tfs.TensorFrame.from_dict({"x": np.array([5.0, 2.0, 9.0])}, num_blocks=2)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_min(x_input, axes=[0]).named("x")
+        assert float(tfs.reduce_blocks(x, df)) == 2.0
+
+    def test_vector_cell_sum(self):
+        df = tfs.TensorFrame.from_dict(
+            {"v": np.arange(12.0).reshape(6, 2)}, num_blocks=3
+        )
+        v_input = tfs.block(df, "v", tf_name="v_input")
+        v = dsl.reduce_sum(v_input, axes=[0]).named("v")
+        res = tfs.reduce_blocks(v, df)
+        np.testing.assert_array_equal(res, df["v"].values.sum(0))
+
+    def test_multi_output(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0), "y": np.ones(4)})
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        y_input = tfs.block(df, "y", tf_name="y_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        y = dsl.reduce_sum(y_input, axes=[0]).named("y")
+        res = tfs.reduce_blocks([x, y], df)
+        assert res["x"] == 6.0 and res["y"] == 4.0
+
+    def test_naming_convention_enforced(self):
+        df = frame_of(x=np.arange(3.0))
+        bad = tfs.block(df, "x", tf_name="wrong")  # must be named 'x_input'
+        s = dsl.reduce_sum(bad, axes=[0]).named("x")
+        with pytest.raises(ValueError, match="x_input"):
+            tfs.reduce_blocks(s, df, feed_dict={"wrong": "x"})
+
+
+class TestReduceRows:
+    def test_pairwise_sum(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(5.0)}, num_blocks=2)
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        x = dsl.add(x1, x2).named("x")
+        assert float(tfs.reduce_rows(x, df)) == 10.0
+
+    def test_single_row_frame(self):
+        df = frame_of(x=np.array([7.0]))
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        assert float(tfs.reduce_rows(dsl.add(x1, x2).named("x"), df)) == 7.0
+
+    def test_vector_cells(self):
+        df = frame_of(v=np.arange(6.0).reshape(3, 2))
+        v1 = dsl.placeholder(ScalarType.float64, Shape((2,)), name="v_1")
+        v2 = dsl.placeholder(ScalarType.float64, Shape((2,)), name="v_2")
+        res = tfs.reduce_rows(dsl.add(v1, v2).named("v"), df)
+        np.testing.assert_array_equal(res, df["v"].values.sum(0))
+
+    def test_left_fold_order(self):
+        # Non-associative graph: fold order must match the reference's
+        # sequential per-partition fold (single block -> exact order).
+        df = frame_of(x=np.array([8.0, 4.0, 2.0]))
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        res = tfs.reduce_rows(dsl.div(x1, x2).named("x"), df)
+        assert float(res) == (8.0 / 4.0) / 2.0
+
+    def test_convention_enforced(self):
+        df = frame_of(x=np.arange(3.0))
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        bad = dsl.placeholder(ScalarType.float64, Shape(()), name="other")
+        with pytest.raises(ValueError, match="convention"):
+            tfs.reduce_rows(dsl.add(x1, bad).named("x"), df)
+
+
+class TestAggregate:
+    def test_grouped_sum(self):
+        # core_test.py:255-264 groupby test shape.
+        df = tfs.TensorFrame.from_dict(
+            {
+                "key": np.array([1, 1, 2, 2, 2], dtype=np.int64),
+                "x": np.array([1.0, 2.0, 10.0, 20.0, 30.0]),
+            }
+        )
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(x, tfs.group_by(df, "key"))
+        assert set(out.columns) == {"key", "x"}
+        got = dict(zip(out["key"].values.tolist(), out["x"].values.tolist()))
+        assert got == {1: 3.0, 2: 60.0}
+
+    def test_grouped_vector_mean_two_outputs(self):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "k": np.array([0, 1, 0, 1], dtype=np.int64),
+                "v": np.arange(8.0).reshape(4, 2),
+                "cnt": np.ones(4),
+            }
+        )
+        v_input = tfs.block(df, "v", tf_name="v_input")
+        c_input = tfs.block(df, "cnt", tf_name="cnt_input")
+        v = dsl.reduce_sum(v_input, axes=[0]).named("v")
+        cnt = dsl.reduce_sum(c_input, axes=[0]).named("cnt")
+        out = tfs.aggregate([v, cnt], tfs.group_by(df, "k"))
+        k0 = np.nonzero(out["k"].values == 0)[0][0]
+        np.testing.assert_array_equal(out["v"].values[k0], [4.0, 6.0])
+        assert out["cnt"].values[k0] == 2.0
+
+    def test_uneven_group_sizes(self):
+        rng = np.random.RandomState(0)
+        keys = rng.randint(0, 5, size=50).astype(np.int64)
+        vals = rng.rand(50)
+        df = tfs.TensorFrame.from_dict({"key": keys, "x": vals})
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(x, tfs.group_by(df, "key"))
+        for k, s in zip(out["key"].values, out["x"].values):
+            np.testing.assert_allclose(s, vals[keys == k].sum(), rtol=1e-12)
+
+    def test_non_scalar_key_rejected(self):
+        df = frame_of(k=np.ones((3, 2)), x=np.arange(3.0))
+        with pytest.raises(ValueError, match="scalar"):
+            tfs.group_by(df, "k")
+
+
+class TestSchemaVerbs:
+    def test_analyze_print_append(self, capsys):
+        df = tfs.TensorFrame.from_dict({"v": [np.ones(3), np.zeros(3)]})
+        df2 = tfs.analyze(df)
+        assert df2.info["v"].cell_shape == Shape((3,))
+        tfs.print_schema(df2)
+        assert "v: float64" in capsys.readouterr().out
+        df3 = tfs.append_shape(df, "v", [None])
+        assert df3.info["v"].cell_shape == Shape((None,))
+
+    def test_explain(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(3.0)})
+        assert "x: float64" in tfs.explain(df)
+
+
+class TestGraphDefImport:
+    def test_map_blocks_from_graphdef_bytes(self):
+        # Export a DSL graph to wire bytes, re-import, execute: the
+        # reference's GraphDef interchange path (graphFromFile,
+        # PythonInterface.scala:115-118).
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        x = tfs.block(df, "x")
+        z = (x + 3.0).named("z")
+        g, fetch_names = dsl.build(z)
+        out = tfs.map_blocks(g.to_bytes(), df, fetch_names=fetch_names)
+        np.testing.assert_array_equal(out["z"].values, np.arange(4.0) + 3.0)
+
+    def test_import_requires_fetches(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        g, _ = dsl.build((tfs.block(df, "x") + 1.0).named("z"))
+        with pytest.raises(ValueError, match="fetch_names"):
+            tfs.map_blocks(g.to_bytes(), df)
+
+
+class TestReviewRegressions:
+    """Regressions from code review: suffix-convention hijacking, fold
+    mapping consistency, fn-front-end trim validation, compile caching."""
+
+    def test_literal_column_name_wins_over_suffix(self):
+        # A column literally named 'temp_1' must not be re-routed to 'temp'.
+        df = frame_of(temp=np.zeros(3), temp_1=np.array([0.0, 1.0, 2.0]))
+        ph = tfs.block(df, "temp_1")
+        out = tfs.map_blocks((ph + 1.0).named("z"), df)
+        np.testing.assert_array_equal(out["z"].values, [1.0, 2.0, 3.0])
+
+    def test_reduce_rows_mapping_mismatch_rejected(self):
+        df = frame_of(a=np.arange(3.0), b=np.arange(3.0))
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        with pytest.raises(ValueError, match="same column"):
+            tfs.reduce_rows(
+                dsl.add(x1, x2).named("x"),
+                df,
+                feed_dict={"x_1": "a", "x_2": "b"},
+            )
+
+    def test_fn_trim_scalar_output_clear_error(self):
+        df = frame_of(x=np.arange(4.0))
+        with pytest.raises(ValueError, match="lead"):
+            tfs.map_blocks(lambda x: {"s": x.sum()}, df, trim=True)
+
+    def test_fn_trim_disagreeing_outputs(self):
+        df = frame_of(x=np.arange(4.0))
+        with pytest.raises(ValueError, match="disagree"):
+            tfs.map_blocks(
+                lambda x: {"a": x[:2], "b": x}, df, trim=True
+            )
+
+    def test_executor_cache_reused_across_calls(self):
+        ex = tfs.Executor()
+        df = frame_of(x=np.arange(4.0))
+        x = tfs.block(df, "x")
+        z = (x + 1.0).named("z")
+        g, fetches = dsl.build(z)
+        from tensorframes_tpu.graph.ir import Graph
+
+        g2 = Graph.from_bytes(g.to_bytes())
+        tfs.map_blocks(g, df, fetch_names=fetches, executor=ex)
+        n = ex.compile_count
+        tfs.map_blocks(g2, df, fetch_names=fetches, executor=ex)
+        assert ex.compile_count == n  # same fingerprint -> cache hit
+
+    def test_map_rows_executor_cached(self):
+        ex = tfs.Executor()
+        df = frame_of(x=np.arange(4.0))
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        ph = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        g, fetches = dsl.build((ph * 2.0).named("y"))
+        tfs.map_rows(g, df, fetch_names=fetches, executor=ex)
+        n = ex.compile_count
+        tfs.map_rows(g, df, fetch_names=fetches, executor=ex)
+        assert ex.compile_count == n

@@ -255,13 +255,21 @@ def map_blocks(
     trim: bool = False,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    mesh=None,
 ) -> TensorFrame:
     """Apply a graph to each block; one jitted XLA call per block.
 
     `DebugRowOps.mapBlocks` (`DebugRowOps.scala:290-400`). With
     ``trim=True`` the row count may change and input columns are dropped
-    (`Operations.scala:59-76`).
+    (`Operations.scala:59-76`). With ``mesh=`` the blocks shard across the
+    device mesh (see `parallel.verbs`).
     """
+    if mesh is not None:
+        from .parallel import verbs as _pverbs
+
+        return _pverbs.map_blocks(
+            fetches, frame, mesh, feed_dict, trim, fetch_names, executor
+        )
     ex = executor or default_executor()
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
         return _map_blocks_fn(fetches, frame, trim, ex)
@@ -522,6 +530,7 @@ def reduce_blocks(
     feed_dict: Optional[Dict[str, str]] = None,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    mesh=None,
 ):
     """Per-block reduce, then one on-device combine over stacked partials.
 
@@ -533,6 +542,12 @@ def reduce_blocks(
     once. Returns a single array for one fetch, a dict for several
     (`_unpack_row`, `core.py:111-125`).
     """
+    if mesh is not None:
+        from .parallel import verbs as _pverbs
+
+        return _pverbs.reduce_blocks(
+            fetches, frame, mesh, feed_dict, fetch_names, executor
+        )
     ex = executor or default_executor()
     graph, fetch_list = _as_graph(fetches, fetch_names)
     overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
@@ -610,6 +625,7 @@ def reduce_rows(
     feed_dict: Optional[Dict[str, str]] = None,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    mesh=None,
 ):
     """Pairwise fold over all rows.
 
@@ -620,6 +636,12 @@ def reduce_rows(
     partials then fold the same way. Fold order matches the reference
     (left fold in row order), so non-associative graphs agree too.
     """
+    if mesh is not None:
+        from .parallel import verbs as _pverbs
+
+        return _pverbs.reduce_rows(
+            fetches, frame, mesh, feed_dict, fetch_names, executor
+        )
     ex = executor or default_executor()
     graph, fetch_list = _as_graph(fetches, fetch_names)
     overrides = _ph_overrides(graph, frame, feed_dict, block_level=False)
@@ -712,6 +734,7 @@ def aggregate(
     feed_dict: Optional[Dict[str, str]] = None,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    mesh=None,
 ) -> TensorFrame:
     """Keyed aggregation with reduce_blocks naming conventions.
 
@@ -722,6 +745,12 @@ def aggregate(
     and vmapped — one XLA call per distinct group size, each batched over
     all groups of that size.
     """
+    if mesh is not None:
+        from .parallel import verbs as _pverbs
+
+        return _pverbs.aggregate(
+            fetches, grouped, mesh, feed_dict, fetch_names, executor
+        )
     ex = executor or default_executor()
     frame = grouped.frame
     graph, fetch_list = _as_graph(fetches, fetch_names)

@@ -1,0 +1,6 @@
+"""Distributed layer: device mesh + collective verbs (replaces Spark)."""
+
+from .mesh import Mesh, P, data_mesh, mesh_2d, shard_to_mesh
+from . import verbs
+
+__all__ = ["Mesh", "P", "data_mesh", "mesh_2d", "shard_to_mesh", "verbs"]

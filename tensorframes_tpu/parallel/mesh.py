@@ -1,0 +1,56 @@
+"""Device-mesh runtime: the substrate that replaces Spark (SURVEY.md §2.5).
+
+The reference distributed work by handing partitions to Spark executors and
+funneling reductions back to the driver (`DebugRowOps.scala:384-398`,
+`:507,530`). Here the substrate is a `jax.sharding.Mesh` over TPU chips:
+blocks shard across the ``data`` axis into per-device HBM, XLA collectives
+(all-gather/psum) ride ICI within a slice and DCN across slices, and the
+compiled program itself is the "broadcast" (replacing
+`sc.broadcast(graph bytes)` at `DebugRowOps.scala:383`).
+
+Axis vocabulary (fixed names so verbs and models compose):
+- ``data``  — batch/row axis (every verb shards over this)
+- ``model`` — tensor-parallel axis (used by models/, optional)
+
+Multi-host: build the mesh from `jax.devices()` after
+`jax.distributed.initialize()`; nothing here assumes single-process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["data_mesh", "mesh_2d", "shard_to_mesh", "P", "Mesh"]
+
+
+def data_mesh(num_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the ``data`` axis (the default for the verbs)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), ("data",))
+
+
+def mesh_2d(data: int, model: int, devices=None) -> Mesh:
+    """2-D ``data x model`` mesh for DP+TP execution (models/)."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < data * model:
+        raise ValueError(
+            f"need {data * model} devices for a {data}x{model} mesh, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[: data * model]).reshape(data, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def shard_to_mesh(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """Place a host array sharded over the mesh's ``data`` axis (lead dim);
+    lead dim must be divisible by the data-axis size."""
+    spec = P("data", *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))

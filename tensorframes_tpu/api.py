@@ -197,6 +197,18 @@ def _ph_overrides(
 # ---------------------------------------------------------------------------
 
 
+def _concat_parts(parts: List) -> "np.ndarray":
+    """Concatenate block outputs, staying on device when the parts are
+    device arrays (no host round-trip for device-resident frames)."""
+    if len(parts) == 1:
+        return parts[0]
+    if any(isinstance(p, jax.Array) for p in parts):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([jnp.asarray(p) for p in parts])
+    return np.concatenate(parts)
+
+
 def _output_frame(
     frame: TensorFrame,
     out_cols: List[Column],
@@ -294,7 +306,7 @@ def map_blocks(
         outs = fn(*feeds)
         bsize = None
         for f, o in zip(fetch_list, outs):
-            o = np.asarray(o)
+            # keep device arrays on device; shape checks are metadata-only
             if not trim and (o.ndim == 0 or o.shape[0] != hi - lo):
                 raise ValueError(
                     f"map_blocks: output {f!r} has lead dim "
@@ -321,7 +333,7 @@ def map_blocks(
         base = _base(f)
         parts = acc[base]
         data = (
-            np.concatenate(parts)
+            _concat_parts(parts)
             if parts
             else np.zeros((0,) + tuple(summary.outputs[base].shape.dims[1:] or ()))
         )
@@ -346,7 +358,6 @@ def _map_blocks_fn(
         outs = jfn(*[frame.column(p).values[lo:hi] for p in params])
         bsize = None
         for name, o in outs.items():
-            o = np.asarray(o)
             if o.ndim == 0:
                 raise ValueError(
                     f"map_blocks: output {name!r} must have a lead (row) dim"
@@ -366,7 +377,7 @@ def _map_blocks_fn(
                     )
             acc.setdefault(name, []).append(o)
         out_sizes.append(bsize if trim else hi - lo)
-    out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+    out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
     offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
     return _output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
 
@@ -422,8 +433,8 @@ def map_rows(
                 continue
             outs = vfn(*[frame.column(c).values[lo:hi] for c in cols_used])
             for n, o in zip(out_names, outs):
-                acc[n].append(np.asarray(o))
-        out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+                acc[n].append(o)
+        out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
     else:
         jrow = ex.cached(
             "row",
@@ -464,8 +475,8 @@ def _map_rows_fn(fn: Callable, frame: TensorFrame) -> TensorFrame:
                 continue
             outs = vfn(*[frame.column(p).values[lo:hi] for p in params])
             for n, o in outs.items():
-                acc.setdefault(n, []).append(np.asarray(o))
-        out_cols = [Column(n, np.concatenate(parts)) for n, parts in acc.items()]
+                acc.setdefault(n, []).append(o)
+        out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
     else:
         jrow = jax.jit(wrapped)
         for i in range(frame.nrows):

@@ -30,15 +30,29 @@ __all__ = ["TensorFrame", "Column"]
 ArrayLike = Union[np.ndarray, Sequence]
 
 
+def _is_device_array(data) -> bool:
+    import jax
+
+    return isinstance(data, jax.Array)
+
+
 class Column:
-    """One column: dense ndarray (lead dim = rows) or ragged object array."""
+    """One column: dense array (lead dim = rows) or ragged object array.
+
+    Dense values may be host numpy OR a `jax.Array` already resident in
+    device HBM (possibly sharded over a mesh) — the north-star design:
+    blocks live on the accelerator, and verbs keep them there
+    (BASELINE.json: "converters bypass the JVM heap and write device
+    buffers")."""
 
     def __init__(self, name: str, data: ArrayLike, dtype: Optional[ScalarType] = None):
         self.name = name
-        if isinstance(data, np.ndarray) and data.dtype != object:
+        if (
+            isinstance(data, np.ndarray) and data.dtype != object
+        ) or _is_device_array(data):
             self.values = data
             self.ragged: Optional[List[np.ndarray]] = None
-            self.dtype = dtype or ScalarType.from_np_dtype(data.dtype)
+            self.dtype = dtype or ScalarType.from_np_dtype(np.dtype(data.dtype))
             # Dense storage: the cell shape is fully known.
             self.cell_shape = Shape(data.shape[1:])
         else:
@@ -284,6 +298,35 @@ class TensorFrame:
         ]
         return TensorFrame(cols, self.offsets)
 
+    # ---- device placement ----------------------------------------------
+    def to_device(self, mesh=None) -> "TensorFrame":
+        """Move dense columns into device HBM (sharded over the mesh's
+        ``data`` axis when a mesh is given). Ragged/string columns stay on
+        host. Verb outputs on a device-resident frame stay on device —
+        host materialization happens only at `to_pandas`/`collect`."""
+        import jax
+
+        new_cols = []
+        for c in self._cols.values():
+            if c.is_dense and c.dtype is not ScalarType.string:
+                # shard_to_mesh splits the lead dim over the 'data' axis only
+                if (
+                    mesh is not None
+                    and "data" in mesh.shape
+                    and len(c) % mesh.shape["data"] == 0
+                ):
+                    from .parallel.mesh import shard_to_mesh
+
+                    vals = shard_to_mesh(mesh, np.asarray(c.values))
+                else:
+                    vals = jax.device_put(np.asarray(c.values))
+                nc = Column(c.name, vals, c.dtype)
+                nc.cell_shape = c.cell_shape
+                new_cols.append(nc)
+            else:
+                new_cols.append(c)
+        return TensorFrame(new_cols, self.offsets)
+
     # ---- export --------------------------------------------------------
     def to_pandas(self):
         import pandas as pd
@@ -291,16 +334,23 @@ class TensorFrame:
         data = {}
         for c in self._cols.values():
             if c.is_dense and c.cell_shape.is_scalar:
-                data[c.name] = c.values
+                data[c.name] = np.asarray(c.values)
             else:
                 data[c.name] = [np.asarray(r).tolist() for r in c.rows()]
         return pd.DataFrame(data)
 
     def collect(self) -> List[Dict[str, np.ndarray]]:
+        # Materialize each dense column once (a device column would
+        # otherwise pay one device->host sync per cell).
+        host: Dict[str, Column] = {}
+        for n, c in self._cols.items():
+            if c.is_dense and not isinstance(c.values, np.ndarray):
+                host[n] = Column(n, np.asarray(c.values), c.dtype)
+            else:
+                host[n] = c
         names = self.columns
         return [
-            {n: self._cols[n].row(i) for n in names}
-            for i in range(self.nrows)
+            {n: host[n].row(i) for n in names} for i in range(self.nrows)
         ]
 
     def print_schema(self) -> None:

@@ -1,0 +1,7 @@
+"""Model zoo: framework-native models in both forms (trainable JAX +
+frozen GraphDef-compatible scoring graphs)."""
+
+from .kmeans import kmeans
+from .mlp import MLP
+
+__all__ = ["MLP", "kmeans"]

@@ -1,0 +1,83 @@
+"""Distributed k-means over TensorFrames.
+
+Re-design of the reference's flagship demo (`kmeans_demo.py`): per-block
+assignment + `unsorted_segment_sum` partial aggregation inside a trimmed
+`map_blocks`, then a block reduce — the exact same verb composition, with
+the block graph compiled by XLA and the cross-block combine riding the
+mesh when one is given.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import api
+from ..frame import TensorFrame
+from ..graph import builder as dsl
+from ..schema import ScalarType
+
+__all__ = ["kmeans"]
+
+
+def _assignment_graph(centers: np.ndarray, feature_col: str):
+    """Trimmed map_blocks graph: block of points -> (k, dim+1) partials.
+
+    Emits one row per centroid: [sum of assigned points, count] — the
+    `unsorted_segment_sum` trick from the reference demo.
+    """
+    k, dim = centers.shape
+    st = ScalarType.from_np_dtype(centers.dtype)
+    from ..schema import Shape
+
+    pts = dsl.placeholder(st, Shape((None, dim)), name=feature_col)
+    c = dsl.constant(centers, name="centers")  # (k, dim)
+    # squared distances via ||p||^2 - 2 p.c + ||c||^2 ; argmin over k
+    p2 = dsl.reduce_sum(dsl.square(pts), axes=[1], keep_dims=True)  # (n,1)
+    pc = dsl.matmul(pts, c, transpose_b=True)  # (n,k)
+    c2 = dsl.reduce_sum(dsl.square(c), axes=[1])  # (k,)
+    d = p2 - 2.0 * pc + c2  # broadcast -> (n,k)
+    assign = dsl.argmin(d, axis=1)
+    assign32 = dsl.cast(assign, ScalarType.int32)
+    # concat [points, 1] so one segment-sum yields sums AND counts
+    ones_n = dsl.reduce_sum(pts * 0.0, axes=[1], keep_dims=True) + 1.0  # (n,1)
+    aug = dsl.concat([pts, ones_n], axis=1)  # (n, dim+1)
+    partial = dsl.unsorted_segment_sum(aug, assign32, k).named("partial")
+    return partial
+
+
+def kmeans(
+    frame: TensorFrame,
+    feature_col: str,
+    k: int,
+    num_iters: int = 10,
+    seed: int = 0,
+    mesh=None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Lloyd iterations; returns (centers, counts)."""
+    if num_iters < 1:
+        raise ValueError("kmeans needs num_iters >= 1")
+    col = frame.column(feature_col)
+    if not col.is_dense or col.cell_shape.rank != 1:
+        raise ValueError("kmeans needs a dense rank-1 feature column")
+    # host copy for center bookkeeping (col.values may be a device array)
+    data = np.asarray(col.values)
+    n, dim = data.shape
+    rng = np.random.RandomState(seed)
+    centers = data[rng.choice(n, size=k, replace=False)].copy()
+    counts = np.zeros(k)
+
+    for _ in range(num_iters):
+        partial = _assignment_graph(centers, feature_col)
+        # trimmed map: each block contributes k partial rows; with a mesh,
+        # blocks shard across devices and partials combine on host (tiny).
+        part_frame = api.map_blocks(partial, frame, trim=True, mesh=mesh)
+        parts = np.asarray(part_frame["partial"].values).reshape(-1, k, dim + 1)
+        totals = parts.sum(axis=0)  # (k, dim+1)
+        counts = totals[:, -1]
+        sums = totals[:, :-1]
+        nonempty = counts > 0
+        centers = centers.copy()
+        centers[nonempty] = sums[nonempty] / counts[nonempty, None]
+    return centers, counts

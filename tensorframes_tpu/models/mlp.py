@@ -1,0 +1,149 @@
+"""Flagship model: a dense MLP classifier, in both framework forms.
+
+Covers BASELINE config #3 ("map_rows 3-layer MLP inference — dense matmul
+per row"): the model can be *frozen* into a GraphDef-compatible scoring
+graph (constants baked in, the moral equivalent of the reference's
+variable freezing, `core.py:42-56`) and scored over a TensorFrame with
+`map_rows`/`map_blocks`; and it is *trainable* as a pure-JAX step with
+DP+TP sharding over a 2-D mesh (`parallel.mesh.mesh_2d`) for the
+multi-chip path.
+
+TPU notes: matmuls run in the MXU; training defaults to float32 params
+with bfloat16 activations off (kept simple and exact for parity tests) —
+flip ``compute_dtype=jnp.bfloat16`` for peak throughput.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graph import builder as dsl
+from ..schema import ScalarType
+
+__all__ = ["MLP"]
+
+
+class MLP:
+    """Dense ``sizes[0] -> ... -> sizes[-1]`` classifier with ReLU hiddens."""
+
+    def __init__(
+        self,
+        sizes: Sequence[int],
+        seed: int = 0,
+        param_dtype=jnp.float32,
+        compute_dtype=None,
+    ):
+        if len(sizes) < 2:
+            raise ValueError("need at least input and output sizes")
+        self.sizes = list(sizes)
+        self.param_dtype = param_dtype
+        self.compute_dtype = compute_dtype or param_dtype
+        key = jax.random.PRNGKey(seed)
+        self.params: List[Tuple[jax.Array, jax.Array]] = []
+        for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            key, k = jax.random.split(key)
+            w = jax.random.normal(k, (fan_in, fan_out), param_dtype)
+            w = w * jnp.sqrt(2.0 / fan_in)
+            b = jnp.zeros((fan_out,), param_dtype)
+            self.params.append((w, b))
+
+    # -- pure forward ----------------------------------------------------
+    def apply(self, params, x):
+        h = x.astype(self.compute_dtype)
+        n = len(params)
+        for i, (w, b) in enumerate(params):
+            h = h @ w.astype(self.compute_dtype) + b.astype(self.compute_dtype)
+            if i < n - 1:
+                h = jax.nn.relu(h)
+        return h  # logits
+
+    def loss(self, params, x, y):
+        logits = self.apply(params, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    def train_step(self, params, x, y, lr=1e-2):
+        loss, grads = jax.value_and_grad(self.loss)(params, x, y)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    # -- DP+TP sharding over a 2-D mesh ---------------------------------
+    def param_specs(self) -> List[Tuple[P, P]]:
+        """Megatron-style TP: odd layers shard columns, even layers shard
+        rows, so activations alternate replicated/sharded and XLA inserts
+        a single psum per pair."""
+        specs = []
+        for i in range(len(self.sizes) - 1):
+            if i % 2 == 0:
+                specs.append((P(None, "model"), P("model")))
+            else:
+                specs.append((P("model", None), P()))
+        return specs
+
+    def shard_params(self, params, mesh: Mesh):
+        return [
+            (
+                jax.device_put(w, NamedSharding(mesh, ws)),
+                jax.device_put(b, NamedSharding(mesh, bs)),
+            )
+            for (w, b), (ws, bs) in zip(params, self.param_specs())
+        ]
+
+    def sharded_train_step(self, mesh: Mesh, lr=1e-2):
+        """jitted training step with DP over rows + TP over features.
+
+        Inputs: x sharded P('data', None), y sharded P('data'); params
+        sharded per `param_specs`. XLA lowers the gradient psum over the
+        ``data`` axis and the activation psums over ``model`` onto ICI.
+        """
+        pspecs = [
+            (NamedSharding(mesh, ws), NamedSharding(mesh, bs))
+            for ws, bs in self.param_specs()
+        ]
+        xspec = NamedSharding(mesh, P("data", None))
+        yspec = NamedSharding(mesh, P("data"))
+
+        def step(params, x, y):
+            return self.train_step(params, x, y, lr)
+
+        return jax.jit(
+            step,
+            in_shardings=(pspecs, xspec, yspec),
+            out_shardings=(pspecs, NamedSharding(mesh, P())),
+        )
+
+    # -- frozen scoring graph (GraphDef interchange) ---------------------
+    def scoring_graph(
+        self, input_name: str = "features", block: bool = True
+    ) -> dsl.Tensor:
+        """Freeze params into a builder-DSL graph: Placeholder -> MatMul ->
+        BiasAdd -> Relu -> ... -> Softmax, named ``probs``. Exportable to
+        GraphDef wire bytes and runnable by any GraphDef consumer."""
+        from ..schema import Shape
+
+        st = ScalarType.from_np_dtype(np.dtype(self.param_dtype))
+        shape = (
+            Shape((None, self.sizes[0])) if block else Shape((self.sizes[0],))
+        )
+        x = dsl.placeholder(st, shape, name=input_name)
+        h = x
+        n = len(self.params)
+        for i, (w, b) in enumerate(self.params):
+            wc = dsl.constant(np.asarray(w), name=f"w{i}")
+            bc = dsl.constant(np.asarray(b), name=f"b{i}")
+            if block:
+                h = dsl.matmul(h, wc)
+            else:
+                # per-row: x is a vector; lift to 1xN for the MXU
+                h = dsl.matmul(dsl.reshape(h, [1, -1]), wc)
+            h = dsl._nary("BiasAdd", [h, bc])
+            if i < n - 1:
+                h = dsl.relu(h)
+        if not block:
+            h = dsl.reshape(h, [self.sizes[-1]])
+        return dsl.softmax(h).named("probs")

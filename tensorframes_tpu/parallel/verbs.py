@@ -78,12 +78,12 @@ def map_blocks(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
 ) -> TensorFrame:
-    """Distributed map_blocks: one block per device."""
-    if trim:
-        # Trimmed outputs have device-dependent sizes; keep the host path.
-        return _api.map_blocks(
-            fetches, frame, feed_dict, trim=True, fetch_names=fetch_names
-        )
+    """Distributed map_blocks: one block per device.
+
+    Trimmed maps work too: the same graph on same-shaped shards produces
+    the same output row count on every device (XLA static shapes), so the
+    shard outputs concatenate cleanly — each device's rows form one block.
+    """
     ex = executor or default_executor()
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
     overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
@@ -97,7 +97,8 @@ def map_blocks(
     main, tail, s = _split(frame, cols_used, ndev)
 
     fn = build_callable(graph, fetch_list, feed_names)
-    acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
+    acc: Dict[str, List] = {_base(f): [] for f in fetch_list}
+    block_sizes: List[int] = []
 
     if s > 0:
         in_specs = tuple(
@@ -116,23 +117,43 @@ def map_blocks(
             ),
         )
         outs = sharded(*[main[c] for c in cols_used])
+        shard_out = None
         for f, o in zip(fetch_list, outs):
-            o = np.asarray(o)
-            if o.shape[0] != s * ndev:
+            if not trim and o.shape[0] != s * ndev:
                 raise ValueError(
-                    f"map_blocks: output {f!r} does not preserve the "
-                    "block row count (distributed maps cannot trim)"
+                    f"map_blocks: output {f!r} does not preserve the block "
+                    "row count; use trim=True for row-count-changing maps"
                 )
+            if trim:
+                if shard_out is None:
+                    shard_out = o.shape[0] // ndev
+                elif o.shape[0] // ndev != shard_out:
+                    raise ValueError(
+                        "map_blocks(trim): outputs disagree on row count"
+                    )
             acc[_base(f)].append(o)
+        block_sizes += [shard_out if trim else s] * ndev
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
         outs = tfn(*[tail[c] for c in cols_used])
+        tail_out = None
         for f, o in zip(fetch_list, outs):
-            acc[_base(f)].append(np.asarray(o))
+            if trim:
+                tail_out = o.shape[0]
+            acc[_base(f)].append(o)
+        block_sizes.append(
+            tail_out if trim else tail[cols_used[0]].shape[0]
+        )
 
     out_cols = [
-        Column(_base(f), np.concatenate(acc[_base(f)])) for f in fetch_list
+        Column(_base(f), _api._concat_parts(acc[_base(f)]))
+        for f in fetch_list
     ]
+    if trim:
+        offsets = list(np.cumsum([0] + block_sizes))
+        return _api._output_frame(
+            frame, out_cols, append_input=False, offsets=offsets
+        )
     return _api._output_frame(
         frame, out_cols, append_input=True, offsets=frame.offsets
     )

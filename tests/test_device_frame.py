@@ -1,0 +1,52 @@
+"""Device-resident frames: columns live in (virtual) device memory and
+verb outputs stay there — no host round-trip between chained verbs."""
+
+import numpy as np
+
+import jax
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.parallel import data_mesh
+
+
+class TestDeviceFrame:
+    def test_to_device_and_map(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)}).to_device()
+        assert isinstance(df["x"].values, jax.Array)
+        out = tfs.map_blocks((tfs.block(df, "x") + 1.0).named("z"), df)
+        # output stayed on device
+        assert isinstance(out["z"].values, jax.Array)
+        np.testing.assert_array_equal(
+            np.asarray(out["z"].values), np.arange(8.0) + 1.0
+        )
+
+    def test_chained_verbs_stay_on_device(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)}).to_device()
+        step1 = tfs.map_blocks((tfs.block(df, "x") * 2.0).named("y"), df)
+        y_input = tfs.block(step1, "y", tf_name="y_input")
+        s = dsl.reduce_sum(y_input, axes=[0]).named("y")
+        res = tfs.reduce_blocks(s, step1)
+        assert float(res) == 2 * np.arange(16.0).sum()
+
+    def test_to_device_sharded_over_mesh(self):
+        mesh = data_mesh()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)}).to_device(mesh)
+        shards = df["x"].values.sharding
+        assert len(shards.device_set) == 8
+        out = tfs.map_blocks((tfs.block(df, "x") + 1.0).named("z"), df, mesh=mesh)
+        np.testing.assert_array_equal(
+            np.asarray(out["z"].values), np.arange(16.0) + 1.0
+        )
+
+    def test_ragged_column_stays_host(self):
+        df = tfs.TensorFrame.from_dict(
+            {"v": [np.arange(2.0), np.arange(3.0)], "x": np.arange(2.0)}
+        ).to_device()
+        assert not df["v"].is_dense
+        assert isinstance(df["x"].values, jax.Array)
+
+    def test_to_pandas_materializes(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)}).to_device()
+        pdf = df.to_pandas()
+        assert list(pdf["x"]) == [0.0, 1.0, 2.0, 3.0]

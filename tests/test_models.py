@@ -1,0 +1,125 @@
+"""Model-zoo tests: MLP (trainable + frozen scoring) and k-means."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.models import MLP, kmeans
+from tensorframes_tpu.parallel import mesh_2d
+
+
+class TestMLP:
+    def test_apply_shapes(self):
+        m = MLP([4, 16, 3], seed=0)
+        x = jnp.ones((5, 4))
+        logits = m.apply(m.params, x)
+        assert logits.shape == (5, 3)
+
+    def test_training_reduces_loss(self):
+        m = MLP([4, 16, 3], seed=0)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(64, 4), dtype=jnp.float32)
+        y = jnp.asarray(rng.randint(0, 3, 64))
+        step = jax.jit(lambda p, x, y: m.train_step(p, x, y, lr=0.1))
+        params = m.params
+        first = None
+        for _ in range(30):
+            params, loss = step(params, x, y)
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first
+
+    def test_frozen_scoring_graph_matches_apply(self):
+        m = MLP([4, 8, 3], seed=1)
+        rng = np.random.RandomState(1)
+        xs = rng.rand(6, 4).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"features": xs})
+        probs_graph = m.scoring_graph("features", block=True)
+        out = tfs.map_blocks(probs_graph, df)
+        expect = jax.nn.softmax(m.apply(m.params, jnp.asarray(xs)), axis=-1)
+        np.testing.assert_allclose(
+            out["probs"].values, np.asarray(expect), rtol=2e-5
+        )
+
+    def test_scoring_graph_survives_graphdef_roundtrip(self):
+        from tensorframes_tpu import dsl
+
+        m = MLP([4, 8, 3], seed=2)
+        g, fetches = dsl.build(m.scoring_graph("features", block=True))
+        g2 = tfs.Graph.from_bytes(g.to_bytes())
+        xs = np.random.RandomState(2).rand(5, 4).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"features": xs})
+        out = tfs.map_blocks(g2, df, fetch_names=fetches)
+        np.testing.assert_allclose(out["probs"].values.sum(1), 1.0, rtol=1e-5)
+
+    def test_sharded_train_step_dp_tp(self):
+        # 4x2 data x model mesh on the 8 virtual CPU devices.
+        mesh = mesh_2d(4, 2)
+        m = MLP([8, 16, 4], seed=0)
+        params = m.shard_params(m.params, mesh)
+        step = m.sharded_train_step(mesh, lr=0.05)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(16, 8), dtype=jnp.float32)
+        y = jnp.asarray(rng.randint(0, 4, 16))
+        params2, loss = step(params, x, y)
+        assert np.isfinite(float(loss))
+        # must match the unsharded step numerically
+        ref_params, ref_loss = jax.jit(
+            lambda p, x, y: m.train_step(p, x, y, lr=0.05)
+        )(m.params, x, y)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(params2[0][0]), np.asarray(ref_params[0][0]), rtol=1e-4
+        )
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        rng = np.random.RandomState(0)
+        blob_centers = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 5.0]])
+        pts = np.concatenate(
+            [c + 0.5 * rng.randn(60, 2) for c in blob_centers]
+        ).astype(np.float64)
+        rng.shuffle(pts)
+        df = tfs.TensorFrame.from_dict({"features": pts}, num_blocks=4)
+        centers, counts = kmeans(df, "features", k=3, num_iters=8, seed=1)
+        assert counts.sum() == len(pts)
+        # every true blob center is close to some learned center
+        for c in blob_centers:
+            d = np.linalg.norm(centers - c, axis=1).min()
+            assert d < 1.0, (c, centers)
+
+
+class TestKMeansDeviceAndMesh:
+    def test_kmeans_on_device_frame(self):
+        rng = np.random.RandomState(0)
+        pts = np.concatenate(
+            [c + 0.3 * rng.randn(40, 2) for c in [[0.0, 0.0], [8.0, 8.0]]]
+        )
+        df = tfs.TensorFrame.from_dict({"features": pts}).to_device()
+        centers, counts = kmeans(df, "features", k=2, num_iters=5, seed=0)
+        assert counts.sum() == len(pts)
+
+    def test_kmeans_with_mesh(self):
+        from tensorframes_tpu.parallel import data_mesh
+
+        rng = np.random.RandomState(1)
+        pts = np.concatenate(
+            [c + 0.3 * rng.randn(64, 2) for c in [[0.0, 0.0], [8.0, 8.0]]]
+        )
+        rng.shuffle(pts)
+        df = tfs.TensorFrame.from_dict({"features": pts})
+        centers, counts = kmeans(
+            df, "features", k=2, num_iters=5, seed=0, mesh=data_mesh()
+        )
+        assert counts.sum() == len(pts)
+        for c in [[0.0, 0.0], [8.0, 8.0]]:
+            assert np.linalg.norm(centers - np.asarray(c), axis=1).min() < 1.0
+
+    def test_num_iters_zero_rejected(self):
+        df = tfs.TensorFrame.from_dict({"features": np.ones((4, 2))})
+        with pytest.raises(ValueError, match="num_iters"):
+            kmeans(df, "features", k=2, num_iters=0)

@@ -132,3 +132,17 @@ class TestDistributedAggregate:
         out = tfs.aggregate(v, tfs.group_by(df, "key"), mesh=mesh)
         for k, s in zip(out["key"].values, out["v"].values):
             np.testing.assert_allclose(s, vals[keys == k].sum(0))
+
+
+class TestDistributedTrimmedMap:
+    def test_trimmed_per_shard_reduction(self, mesh):
+        # Each shard emits one row (its block sum): 16 rows -> 8 rows.
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        x = tfs.block(df, "x")
+        s = dsl.reduce_sum(x, axes=[0], keep_dims=True).named("s")
+        out = tfs.map_blocks(s, df, trim=True, mesh=mesh)
+        assert out.columns == ["s"]
+        assert out.nrows == 8
+        np.testing.assert_array_equal(
+            out["s"].values, np.arange(16.0).reshape(8, 2).sum(1)
+        )

@@ -66,6 +66,27 @@ __all__ = [
 Fetches = Union[dsl.Tensor, Sequence[dsl.Tensor], Graph, bytes, str, Callable]
 
 
+def _is_pandas(obj) -> bool:
+    return type(obj).__module__.startswith("pandas")
+
+
+def _pandas_in_out(verb):
+    """Accept a pandas DataFrame wherever a TensorFrame is expected and
+    return pandas back — the reference's local-debug path
+    (`_map_pd`, `core.py:171-183`, dispatch `:263-265`, `:311-313`)."""
+    import functools
+
+    @functools.wraps(verb)
+    def wrapper(fetches, frame, *args, **kwargs):
+        if _is_pandas(frame):
+            tf_frame = TensorFrame.from_pandas(frame)
+            out = verb(fetches, tf_frame, *args, **kwargs)
+            return out.to_pandas() if isinstance(out, TensorFrame) else out
+        return verb(fetches, frame, *args, **kwargs)
+
+    return wrapper
+
+
 # ---------------------------------------------------------------------------
 # graph normalization
 # ---------------------------------------------------------------------------
@@ -260,6 +281,7 @@ def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
 # ---------------------------------------------------------------------------
 
 
+@_pandas_in_out
 def map_blocks(
     fetches: Fetches,
     frame: TensorFrame,
@@ -387,6 +409,7 @@ def _map_blocks_fn(
 # ---------------------------------------------------------------------------
 
 
+@_pandas_in_out
 def map_rows(
     fetches: Fetches,
     frame: TensorFrame,
@@ -535,6 +558,7 @@ def _validate_reduce_blocks(
             )
 
 
+@_pandas_in_out
 def reduce_blocks(
     fetches: Fetches,
     frame: TensorFrame,
@@ -630,6 +654,7 @@ def _validate_reduce_rows(summary: GraphSummary, fetch_list: List[str]) -> None:
             )
 
 
+@_pandas_in_out
 def reduce_rows(
     fetches: Fetches,
     frame: TensorFrame,

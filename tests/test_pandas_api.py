@@ -1,0 +1,45 @@
+"""Pandas front-end parity: verbs accept pandas DataFrames and return
+pandas, the reference's local-debug path (`_map_pd`, `core.py:171-183`)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.schema import ScalarType, Shape
+
+
+class TestPandasAPI:
+    def test_map_blocks_pandas(self):
+        pdf = pd.DataFrame({"x": [1.0, 2.0, 3.0]})
+        ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="x")
+        out = tfs.map_blocks((ph + 3.0).named("z"), pdf)
+        assert isinstance(out, pd.DataFrame)
+        assert list(out["z"]) == [4.0, 5.0, 6.0]
+        assert list(out.columns) == ["z", "x"]
+
+    def test_map_rows_pandas(self):
+        pdf = pd.DataFrame({"x": [1.0, 2.0]})
+        ph = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        out = tfs.map_rows((ph * 2.0).named("y"), pdf)
+        assert list(out["y"]) == [2.0, 4.0]
+
+    def test_reduce_blocks_pandas(self):
+        pdf = pd.DataFrame({"x": [1.0, 2.0, 3.0]})
+        ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="x_input")
+        res = tfs.reduce_blocks(dsl.reduce_sum(ph, axes=[0]).named("x"), pdf)
+        assert float(res) == 6.0
+
+    def test_reduce_rows_pandas(self):
+        pdf = pd.DataFrame({"x": [1.0, 2.0, 4.0]})
+        a = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        b = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        res = tfs.reduce_rows(dsl.add(a, b).named("x"), pdf)
+        assert float(res) == 7.0
+
+    def test_vector_cells_pandas(self):
+        pdf = pd.DataFrame({"v": [[1.0, 2.0], [3.0, 4.0]]})
+        ph = dsl.placeholder(ScalarType.float64, Shape((None, 2)), name="v")
+        out = tfs.map_blocks((ph * 2.0).named("w"), pdf)
+        assert out["w"][1] == [6.0, 8.0]

@@ -1,0 +1,229 @@
+"""Golden conformance vs real TensorFlow.
+
+The reference's cross-implementation check ran a Python TF subprocess and
+compared graphs node-for-node (`ExtractNodes.compareOutput`,
+`dsl/ExtractNodes.scala:14-77`). Here we go one better: build each graph
+with REAL TensorFlow, serialize its GraphDef, import the wire bytes with
+our parser, execute through our JAX lowering, and compare numerical
+results against a TF session — proving wire-format, op-semantics, and
+dtype parity end to end with zero TF in the production path."""
+
+import numpy as np
+import pytest
+
+tf1 = pytest.importorskip("tensorflow.compat.v1")
+
+from tensorframes_tpu.graph.ir import Graph
+from tensorframes_tpu.ops.lowering import build_callable
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _eager_off():
+    tf1.disable_eager_execution()
+
+
+def run_both(build, feeds: dict, fetch: str):
+    """build(tf1) constructs a graph in a fresh TF Graph; returns
+    (tf_result, ours) for the fetch under the same feeds."""
+    g = tf1.Graph()
+    with g.as_default():
+        build(tf1)
+    with tf1.Session(graph=g) as sess:
+        tf_out = sess.run(
+            fetch + ":0", {k + ":0": v for k, v in feeds.items()}
+        )
+    wire = g.as_graph_def().SerializeToString()
+    ours_graph = Graph.from_bytes(wire)
+    feed_names = sorted(feeds)
+    fn = build_callable(ours_graph, [fetch], feed_names)
+    (ours,) = fn(*[feeds[k] for k in feed_names])
+    return np.asarray(tf_out), np.asarray(ours)
+
+
+def assert_match(build, feeds, fetch, rtol=1e-6):
+    theirs, ours = run_both(build, feeds, fetch)
+    assert theirs.dtype == ours.dtype, (theirs.dtype, ours.dtype)
+    assert theirs.shape == ours.shape, (theirs.shape, ours.shape)
+    np.testing.assert_allclose(ours, theirs, rtol=rtol, atol=1e-6)
+
+
+class TestElementwiseParity:
+    def test_add_const(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None], name="x")
+            tf.add(x, tf.constant(3.0, tf.float64), name="z")
+
+        assert_match(build, {"x": np.arange(5.0)}, "z")
+
+    def test_int_div(self):
+        def build(tf):
+            a = tf.placeholder(tf.int32, [None], name="a")
+            b = tf.placeholder(tf.int32, [None], name="b")
+            tf.div(a, b, name="z")
+
+        assert_match(
+            build,
+            {
+                "a": np.array([-7, 7, 9], np.int32),
+                "b": np.array([2, 2, -4], np.int32),
+            },
+            "z",
+        )
+
+    def test_chained_math(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None], name="x")
+            y = tf.sqrt(tf.abs(x * x - x) + 1.0)
+            tf.tanh(y / 3.0, name="z")
+
+        assert_match(build, {"x": np.linspace(-2, 2, 9, dtype=np.float32)}, "z")
+
+
+class TestReductionParity:
+    def test_reduce_sum_keepdims(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 4], name="x")
+            tf.reduce_sum(x, axis=[0], keepdims=True, name="z")
+
+        assert_match(build, {"x": np.arange(12.0).reshape(3, 4)}, "z")
+
+    def test_reduce_mean_negative_axis(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 4], name="x")
+            tf.reduce_mean(x, axis=-1, name="z")
+
+        assert_match(
+            build, {"x": np.arange(8, dtype=np.float32).reshape(2, 4)}, "z"
+        )
+
+    def test_argmin_int64(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 3], name="x")
+            tf.argmin(x, axis=1, name="z")
+
+        assert_match(
+            build,
+            {"x": np.array([[3, 1, 2], [0, 5, -1]], np.float32)},
+            "z",
+        )
+
+    def test_segment_sum(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 2], name="x")
+            ids = tf.constant([0, 0, 2], tf.int32)
+            tf.unsorted_segment_sum(x, ids, 3, name="z")
+
+        assert_match(build, {"x": np.arange(6.0).reshape(3, 2)}, "z")
+
+
+class TestShapeOpParity:
+    def test_reshape_concat_squeeze(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 4], name="x")
+            a = tf.reshape(x, [-1, 2, 2])
+            b = tf.concat([a, a], axis=2)
+            tf.squeeze(tf.expand_dims(b, 0), axis=[0], name="z")
+
+        assert_match(
+            build, {"x": np.arange(8, dtype=np.float32).reshape(2, 4)}, "z"
+        )
+
+    def test_strided_slice(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 6], name="x")
+            y = x[:, 1:5:2]
+            tf.identity(y, name="z")
+
+        assert_match(
+            build, {"x": np.arange(12, dtype=np.float32).reshape(2, 6)}, "z"
+        )
+
+    def test_cast_and_pack(self):
+        def build(tf):
+            x = tf.placeholder(tf.int32, [None], name="x")
+            y = tf.cast(x, tf.float32)
+            tf.stack([y, y * 2.0], axis=1, name="z")
+
+        assert_match(build, {"x": np.arange(4, dtype=np.int32)}, "z")
+
+
+class TestNNParity:
+    def test_matmul_bias_relu_softmax(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 4], name="x")
+            w = tf.constant(
+                np.random.RandomState(0).rand(4, 3), dtype=tf.float32
+            )
+            b = tf.constant([0.1, -0.2, 0.3], tf.float32)
+            h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w), b))
+            tf.nn.softmax(h, name="z")
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(1).rand(5, 4).astype(np.float32)},
+            "z",
+            rtol=1e-5,
+        )
+
+    def test_conv2d_maxpool(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 8, 8, 2], name="x")
+            k = tf.constant(
+                np.random.RandomState(0).rand(3, 3, 2, 4), dtype=tf.float32
+            )
+            c = tf.nn.conv2d(x, k, strides=[1, 1, 1, 1], padding="SAME")
+            tf.nn.max_pool(
+                c, ksize=[1, 2, 2, 1], strides=[1, 2, 2, 1],
+                padding="VALID", name="z",
+            )
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(2).rand(2, 8, 8, 2).astype(np.float32)},
+            "z",
+            rtol=1e-4,
+        )
+
+    def test_avgpool_same_padding(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 5, 5, 1], name="x")
+            tf.nn.avg_pool(
+                x, ksize=[1, 3, 3, 1], strides=[1, 2, 2, 1],
+                padding="SAME", name="z",
+            )
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(3).rand(1, 5, 5, 1).astype(np.float32)},
+            "z",
+            rtol=1e-5,
+        )
+
+
+class TestVariableFreezing:
+    def test_frozen_variables_execute(self):
+        # The reference freezes TF variables into constants before shipping
+        # (`_initialize_variables`, core.py:42-56). Prove frozen graphs from
+        # real TF run bit-compatibly through our executor.
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf1.float32, [None, 3], name="x")
+            w = tf1.get_variable(
+                "w",
+                initializer=np.random.RandomState(0)
+                .rand(3, 2)
+                .astype(np.float32),
+            )
+            tf1.matmul(x, w, name="z")
+            init = tf1.global_variables_initializer()
+        with tf1.Session(graph=g) as sess:
+            sess.run(init)
+            frozen = tf1.graph_util.convert_variables_to_constants(
+                sess, g.as_graph_def(), ["z"]
+            )
+            xs = np.random.RandomState(1).rand(4, 3).astype(np.float32)
+            theirs = sess.run("z:0", {"x:0": xs})
+        ours_graph = Graph.from_bytes(frozen.SerializeToString())
+        fn = build_callable(ours_graph, ["z"], ["x"])
+        (ours,) = fn(xs)
+        np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5)

@@ -1,0 +1,56 @@
+// Columnar conversion kernels: the framework's native data plane.
+//
+// The reference's hottest loops were the boxed row<->tensor converters on
+// the JVM heap (DataOps.convertFast0/convertBackFast0, DataOps.scala:20-81;
+// per-cell Row.getSeq in datatypes.scala:114-127). Here the columnar frame
+// is already in tensor layout, so the only remaining host-side hot loop is
+// RAGGED row packing: variable-length cells -> one padded dense block +
+// length vector (for masked block execution / map_rows batching). These
+// kernels do that with raw memcpy, no Python object iteration.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Pack n ragged cells into out[n, max_len] (elem_size bytes per element).
+// cells: pointers to each cell's data; lens: element count per cell.
+// pad byte pattern is zeros. lens_out receives a copy of lens as int32.
+void tfs_pack_ragged(const void** cells, const int64_t* lens, int64_t n,
+                     int64_t max_len, int64_t elem_size, void* out,
+                     int32_t* lens_out) {
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t i = 0; i < n; i++) {
+    const int64_t nbytes = lens[i] * elem_size;
+    std::memcpy(dst, cells[i], nbytes);
+    if (nbytes < row_bytes) std::memset(dst + nbytes, 0, row_bytes - nbytes);
+    dst += row_bytes;
+    lens_out[i] = static_cast<int32_t>(lens[i]);
+  }
+}
+
+// Scatter rows of a dense block back into ragged cells (inverse of pack):
+// copies lens[i] elements of row i into cells[i].
+void tfs_unpack_ragged(const void* block, const int64_t* lens, int64_t n,
+                       int64_t max_len, int64_t elem_size, void** cells) {
+  const uint8_t* src = static_cast<const uint8_t*>(block);
+  const int64_t row_bytes = max_len * elem_size;
+  for (int64_t i = 0; i < n; i++) {
+    std::memcpy(cells[i], src, lens[i] * elem_size);
+    src += row_bytes;
+  }
+}
+
+// Gather rows: out[i] = data[idx[i]] for row_bytes-sized rows. The host
+// side of aggregate's sort-by-key (api.aggregate col_data[order]).
+void tfs_gather_rows(const void* data, const int64_t* idx, int64_t n,
+                     int64_t row_bytes, void* out) {
+  const uint8_t* src = static_cast<const uint8_t*>(data);
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  for (int64_t i = 0; i < n; i++) {
+    std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+  }
+}
+
+}  // extern "C"

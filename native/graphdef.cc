@@ -1,0 +1,293 @@
+// Native GraphDef layer: protobuf wire parsing, validation, toposort.
+//
+// TPU-native counterpart of the reference's native graph plumbing: where
+// TensorFrames handed GraphDef bytes to libtensorflow's C++ importer on
+// every task (TensorFlowOps.scala:64-95 via JNI), this library parses the
+// same wire format, builds the node table, validates it (duplicate names,
+// dangling inputs, cycles) and computes the topological order — all
+// without libtensorflow or libprotobuf (the wire format is decoded
+// directly, mirroring proto/wire.py).
+//
+// Exposed as a C ABI consumed from Python via ctypes
+// (tensorframes_tpu/native/__init__.py). Handle-based: tfs_graph_parse
+// returns an opaque graph handle; getters read node fields; spans into the
+// original buffer are copied so the handle owns all memory.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Span {
+  const uint8_t* p = nullptr;
+  size_t len = 0;
+};
+
+struct AttrEntry {
+  std::string key;
+  std::vector<uint8_t> value;  // raw AttrValue bytes
+};
+
+struct Node {
+  std::string name;
+  std::string op;
+  std::string device;
+  std::vector<std::string> inputs;
+  std::vector<AttrEntry> attrs;
+};
+
+struct GraphHandle {
+  std::vector<Node> nodes;
+  std::vector<int32_t> topo;  // filled by validate()
+  std::string error;
+  int64_t producer = 0;
+};
+
+// --- varint / field iteration (wire format) -------------------------------
+
+bool read_varint(const uint8_t* buf, size_t len, size_t* pos, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t b = buf[(*pos)++];
+    result |= static_cast<uint64_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *out = result;
+      return true;
+    }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+// Iterate protobuf fields; calls fn(field_number, wire_type, span_or_value).
+// For LEN fields span points into buf; for VARINT value is in `varint`.
+template <typename Fn>
+bool iter_fields(const uint8_t* buf, size_t len, Fn fn) {
+  size_t pos = 0;
+  while (pos < len) {
+    uint64_t tag;
+    if (!read_varint(buf, len, &pos, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wtype = tag & 7;
+    if (wtype == 0) {  // varint
+      uint64_t v;
+      if (!read_varint(buf, len, &pos, &v)) return false;
+      if (!fn(field, wtype, Span{nullptr, 0}, v)) return false;
+    } else if (wtype == 2) {  // length-delimited
+      uint64_t l;
+      if (!read_varint(buf, len, &pos, &l)) return false;
+      if (pos + l > len) return false;
+      if (!fn(field, wtype, Span{buf + pos, static_cast<size_t>(l)}, 0))
+        return false;
+      pos += l;
+    } else if (wtype == 1) {  // fixed64
+      if (pos + 8 > len) return false;
+      if (!fn(field, wtype, Span{buf + pos, 8}, 0)) return false;
+      pos += 8;
+    } else if (wtype == 5) {  // fixed32
+      if (pos + 4 > len) return false;
+      if (!fn(field, wtype, Span{buf + pos, 4}, 0)) return false;
+      pos += 4;
+    } else {
+      return false;  // groups unsupported
+    }
+  }
+  return true;
+}
+
+std::string span_str(const Span& s) {
+  return std::string(reinterpret_cast<const char*>(s.p), s.len);
+}
+
+bool parse_node(const Span& span, Node* node) {
+  return iter_fields(
+      span.p, span.len,
+      [&](uint32_t field, uint32_t wtype, Span s, uint64_t v) {
+        switch (field) {
+          case 1: node->name = span_str(s); break;
+          case 2: node->op = span_str(s); break;
+          case 3: node->inputs.push_back(span_str(s)); break;
+          case 4: node->device = span_str(s); break;
+          case 5: {  // map<string, AttrValue> entry
+            AttrEntry e;
+            iter_fields(s.p, s.len,
+                        [&](uint32_t f2, uint32_t, Span s2, uint64_t) {
+                          if (f2 == 1) e.key = span_str(s2);
+                          if (f2 == 2)
+                            e.value.assign(s2.p, s2.p + s2.len);
+                          return true;
+                        });
+            node->attrs.push_back(std::move(e));
+            break;
+          }
+          default: break;  // unknown fields skipped
+        }
+        return true;
+      });
+}
+
+// strip ^ctrl prefix and :k output suffix from an input edge
+std::string edge_base(const std::string& edge) {
+  size_t start = (!edge.empty() && edge[0] == '^') ? 1 : 0;
+  size_t colon = edge.rfind(':');
+  if (colon != std::string::npos && colon > start) {
+    bool digits = colon + 1 < edge.size();
+    for (size_t i = colon + 1; i < edge.size(); i++)
+      if (!isdigit(edge[i])) digits = false;
+    if (digits) return edge.substr(start, colon - start);
+  }
+  return edge.substr(start);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse GraphDef wire bytes. Returns handle or nullptr (err filled).
+void* tfs_graph_parse(const uint8_t* buf, size_t len, char* err,
+                      size_t errlen) {
+  auto* g = new GraphHandle();
+  bool ok = iter_fields(
+      buf, len, [&](uint32_t field, uint32_t wtype, Span s, uint64_t v) {
+        if (field == 1 && wtype == 2) {
+          Node n;
+          if (!parse_node(s, &n)) return false;
+          g->nodes.push_back(std::move(n));
+        } else if (field == 4 && wtype == 2) {  // VersionDef
+          iter_fields(s.p, s.len,
+                      [&](uint32_t f2, uint32_t, Span, uint64_t v2) {
+                        if (f2 == 1) g->producer = static_cast<int64_t>(v2);
+                        return true;
+                      });
+        }
+        return true;
+      });
+  if (!ok) {
+    snprintf(err, errlen, "malformed GraphDef wire data");
+    delete g;
+    return nullptr;
+  }
+  return g;
+}
+
+void tfs_graph_free(void* h) { delete static_cast<GraphHandle*>(h); }
+
+int64_t tfs_graph_num_nodes(void* h) {
+  return static_cast<GraphHandle*>(h)->nodes.size();
+}
+
+int64_t tfs_graph_producer(void* h) {
+  return static_cast<GraphHandle*>(h)->producer;
+}
+
+const char* tfs_graph_node_name(void* h, int64_t i) {
+  return static_cast<GraphHandle*>(h)->nodes[i].name.c_str();
+}
+
+const char* tfs_graph_node_op(void* h, int64_t i) {
+  return static_cast<GraphHandle*>(h)->nodes[i].op.c_str();
+}
+
+const char* tfs_graph_node_device(void* h, int64_t i) {
+  return static_cast<GraphHandle*>(h)->nodes[i].device.c_str();
+}
+
+int64_t tfs_graph_node_num_inputs(void* h, int64_t i) {
+  return static_cast<GraphHandle*>(h)->nodes[i].inputs.size();
+}
+
+const char* tfs_graph_node_input(void* h, int64_t i, int64_t j) {
+  return static_cast<GraphHandle*>(h)->nodes[i].inputs[j].c_str();
+}
+
+int64_t tfs_graph_node_num_attrs(void* h, int64_t i) {
+  return static_cast<GraphHandle*>(h)->nodes[i].attrs.size();
+}
+
+const char* tfs_graph_node_attr_key(void* h, int64_t i, int64_t j) {
+  return static_cast<GraphHandle*>(h)->nodes[i].attrs[j].key.c_str();
+}
+
+const uint8_t* tfs_graph_node_attr_value(void* h, int64_t i, int64_t j,
+                                         int64_t* out_len) {
+  auto& v = static_cast<GraphHandle*>(h)->nodes[i].attrs[j].value;
+  *out_len = v.size();
+  return v.data();
+}
+
+// Validate: duplicate names, dangling inputs, cycles. Fills the topo order.
+// Returns 0 on success; 1 on error (err filled).
+int tfs_graph_validate(void* h, char* err, size_t errlen) {
+  auto* g = static_cast<GraphHandle*>(h);
+  std::unordered_map<std::string, int32_t> index;
+  for (size_t i = 0; i < g->nodes.size(); i++) {
+    auto r = index.emplace(g->nodes[i].name, static_cast<int32_t>(i));
+    if (!r.second) {
+      snprintf(err, errlen, "duplicate node name '%s'",
+               g->nodes[i].name.c_str());
+      return 1;
+    }
+  }
+  // Kahn's algorithm over base edges.
+  std::vector<std::vector<int32_t>> consumers(g->nodes.size());
+  std::vector<int32_t> indegree(g->nodes.size(), 0);
+  for (size_t i = 0; i < g->nodes.size(); i++) {
+    for (const auto& e : g->nodes[i].inputs) {
+      auto it = index.find(edge_base(e));
+      if (it == index.end()) {
+        snprintf(err, errlen, "node '%s' consumes unknown node '%s'",
+                 g->nodes[i].name.c_str(), edge_base(e).c_str());
+        return 1;
+      }
+      consumers[it->second].push_back(static_cast<int32_t>(i));
+      indegree[i]++;
+    }
+  }
+  g->topo.clear();
+  std::vector<int32_t> ready;
+  for (size_t i = 0; i < g->nodes.size(); i++)
+    if (indegree[i] == 0) ready.push_back(static_cast<int32_t>(i));
+  while (!ready.empty()) {
+    int32_t n = ready.back();
+    ready.pop_back();
+    g->topo.push_back(n);
+    for (int32_t c : consumers[n])
+      if (--indegree[c] == 0) ready.push_back(c);
+  }
+  if (g->topo.size() != g->nodes.size()) {
+    snprintf(err, errlen, "graph contains a cycle");
+    return 1;
+  }
+  return 0;
+}
+
+// Copy the topo order (node indices). Call after tfs_graph_validate.
+int64_t tfs_graph_topo(void* h, int32_t* out, int64_t cap) {
+  auto* g = static_cast<GraphHandle*>(h);
+  int64_t n = static_cast<int64_t>(g->topo.size());
+  for (int64_t i = 0; i < n && i < cap; i++) out[i] = g->topo[i];
+  return n;
+}
+
+// Indices of zero-input Placeholder nodes (graph inputs, the
+// analyzeGraphTF classification, TensorFlowOps.scala:106-108).
+int64_t tfs_graph_placeholders(void* h, int32_t* out, int64_t cap) {
+  auto* g = static_cast<GraphHandle*>(h);
+  int64_t count = 0;
+  for (size_t i = 0; i < g->nodes.size(); i++) {
+    const auto& n = g->nodes[i];
+    if ((n.op == "Placeholder" || n.op == "PlaceholderV2") &&
+        n.inputs.empty()) {
+      if (count < cap) out[count] = static_cast<int32_t>(i);
+      count++;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -162,6 +162,25 @@ class Graph:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Graph":
+        """Parse GraphDef wire bytes. Uses the native C++ parser
+        (`native/graphdef.cc` — parse + validate + cycle check in one pass)
+        when built, with the pure-Python wire codec as fallback."""
+        from ..native import parse_graph_native
+        from ..proto.graphdef import AttrValue
+
+        native = None
+        try:
+            native = parse_graph_native(data)
+        except ValueError:
+            raise  # malformed/invalid graph: surface the native error
+        if native is not None:
+            g = cls()
+            for name, op, inputs, raw_attrs in native:
+                attrs = {
+                    k: AttrValue.from_bytes(v) for k, v in raw_attrs.items()
+                }
+                g.add(GraphNode(name, op, inputs, attrs))
+            return g
         return cls.from_graph_def(GraphDef.from_bytes(data))
 
     @classmethod

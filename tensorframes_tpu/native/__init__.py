@@ -1,0 +1,227 @@
+"""ctypes loader for the native runtime library (native/libtfs_native.so).
+
+The native layer carries the framework's non-JAX native components
+(SURVEY.md §2.4): GraphDef wire parsing + validation + toposort in C++
+(`native/graphdef.cc`) and the ragged columnar conversion kernels
+(`native/convert.cc`). Everything degrades gracefully: if the library is
+not built, pure-Python implementations are used and `available()` returns
+False.
+
+Build with ``make -C native`` at the repo root (or set TFS_NATIVE_LIB).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "available",
+    "parse_graph_native",
+    "pack_ragged",
+    "unpack_ragged",
+    "gather_rows",
+]
+
+_lib = None
+_tried = False
+
+
+def _find_lib() -> Optional[str]:
+    env = os.environ.get("TFS_NATIVE_LIB")
+    if env and os.path.exists(env):
+        return env
+    here = os.path.dirname(os.path.abspath(__file__))
+    for cand in [
+        os.path.join(here, "libtfs_native.so"),
+        os.path.join(os.path.dirname(os.path.dirname(here)), "native", "libtfs_native.so"),
+    ]:
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.tfs_graph_parse.restype = ctypes.c_void_p
+    lib.tfs_graph_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.tfs_graph_free.argtypes = [ctypes.c_void_p]
+    lib.tfs_graph_num_nodes.restype = ctypes.c_int64
+    lib.tfs_graph_num_nodes.argtypes = [ctypes.c_void_p]
+    lib.tfs_graph_producer.restype = ctypes.c_int64
+    lib.tfs_graph_producer.argtypes = [ctypes.c_void_p]
+    for fn in ("tfs_graph_node_name", "tfs_graph_node_op", "tfs_graph_node_device"):
+        getattr(lib, fn).restype = ctypes.c_char_p
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tfs_graph_node_num_inputs.restype = ctypes.c_int64
+    lib.tfs_graph_node_num_inputs.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tfs_graph_node_input.restype = ctypes.c_char_p
+    lib.tfs_graph_node_input.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tfs_graph_node_num_attrs.restype = ctypes.c_int64
+    lib.tfs_graph_node_num_attrs.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tfs_graph_node_attr_key.restype = ctypes.c_char_p
+    lib.tfs_graph_node_attr_key.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.tfs_graph_node_attr_value.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.tfs_graph_node_attr_value.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.tfs_graph_validate.restype = ctypes.c_int
+    lib.tfs_graph_validate.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    lib.tfs_graph_placeholders.restype = ctypes.c_int64
+    lib.tfs_graph_placeholders.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+    ]
+    lib.tfs_pack_ragged.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.tfs_gather_rows.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def parse_graph_native(
+    data: bytes,
+) -> Optional[List[Tuple[str, str, List[str], Dict[str, bytes]]]]:
+    """Parse GraphDef wire bytes with the C++ parser; validate (duplicate
+    names, dangling inputs, cycles). Returns per-node
+    (name, op, inputs, {attr_key: raw AttrValue bytes}), or None if the
+    native library is unavailable. Raises ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    err = ctypes.create_string_buffer(256)
+    h = lib.tfs_graph_parse(data, len(data), err, 256)
+    if not h:
+        raise ValueError(f"native GraphDef parse failed: {err.value.decode()}")
+    try:
+        if lib.tfs_graph_validate(h, err, 256) != 0:
+            raise ValueError(
+                f"invalid GraphDef: {err.value.decode()} (native validation)"
+            )
+        n = lib.tfs_graph_num_nodes(h)
+        nodes = []
+        for i in range(n):
+            name = lib.tfs_graph_node_name(h, i).decode()
+            op = lib.tfs_graph_node_op(h, i).decode()
+            inputs = [
+                lib.tfs_graph_node_input(h, i, j).decode()
+                for j in range(lib.tfs_graph_node_num_inputs(h, i))
+            ]
+            attrs: Dict[str, bytes] = {}
+            for j in range(lib.tfs_graph_node_num_attrs(h, i)):
+                key = lib.tfs_graph_node_attr_key(h, i, j).decode()
+                alen = ctypes.c_int64()
+                ptr = lib.tfs_graph_node_attr_value(h, i, j, ctypes.byref(alen))
+                attrs[key] = ctypes.string_at(ptr, alen.value)
+            nodes.append((name, op, inputs, attrs))
+        return nodes
+    finally:
+        lib.tfs_graph_free(h)
+
+
+def pack_ragged(cells: List[np.ndarray]) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Pack rank-1 ragged cells into (padded[n, max_len], lens[n]) with the
+    C++ kernel. Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(cells)
+    if n == 0:
+        raise ValueError("pack_ragged needs at least one cell")
+    dtype = cells[0].dtype
+    cells = [np.ascontiguousarray(c, dtype=dtype) for c in cells]
+    lens = np.array([c.size for c in cells], dtype=np.int64)
+    max_len = int(lens.max())
+    out = np.empty((n, max_len), dtype=dtype)
+    lens_out = np.empty(n, dtype=np.int32)
+    ptrs = (ctypes.c_void_p * n)(
+        *[c.ctypes.data_as(ctypes.c_void_p) for c in cells]
+    )
+    lib.tfs_pack_ragged(
+        ptrs,
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        max_len,
+        dtype.itemsize,
+        out.ctypes.data_as(ctypes.c_void_p),
+        lens_out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out, lens_out
+
+
+def unpack_ragged(block: np.ndarray, lens: np.ndarray) -> Optional[List[np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    return [np.array(block[i, : lens[i]]) for i in range(len(lens))]
+
+
+def gather_rows(data: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
+    """out[i] = data[idx[i]] via the native memcpy kernel."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = len(idx)
+    row_bytes = data.itemsize * int(np.prod(data.shape[1:], initial=1))
+    out = np.empty((n,) + data.shape[1:], dtype=data.dtype)
+    lib.tfs_gather_rows(
+        data.ctypes.data_as(ctypes.c_void_p),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        row_bytes,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out

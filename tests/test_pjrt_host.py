@@ -1,0 +1,79 @@
+"""Native C++ PJRT host tests — require exclusive access to a real PJRT
+plugin (the TPU under the driver), so they are gated behind
+``TFS_TEST_PJRT=1`` and skipped in the default CPU suite.
+
+Run: ``TFS_TEST_PJRT=1 PYTHONPATH=.:/root/.axon_site python -m pytest
+tests/test_pjrt_host.py -q`` (fresh process; jax stays on CPU)."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TFS_TEST_PJRT") != "1",
+    reason="needs exclusive TPU access; set TFS_TEST_PJRT=1",
+)
+
+
+@pytest.fixture(scope="module")
+def host():
+    from tensorframes_tpu.runtime.pjrt_host import PjrtHost, default_plugin_path
+
+    if default_plugin_path() is None:
+        pytest.skip("no PJRT plugin available")
+    return PjrtHost()
+
+
+class TestPjrtHost:
+    def test_platform(self, host):
+        assert host.platform in ("tpu", "cpu")
+        assert host.device_count >= 1
+
+    def test_elementwise(self, host):
+        import jax.numpy as jnp
+
+        from tensorframes_tpu.runtime.pjrt_host import stablehlo_for
+
+        mlir = stablehlo_for(lambda x: x * 2 + 1, jnp.zeros((8,), jnp.float32))
+        exe = host.compile(mlir)
+        (out,) = exe(
+            np.arange(8, dtype=np.float32), out_specs=[((8,), np.float32)]
+        )
+        np.testing.assert_array_equal(out, np.arange(8.0, dtype=np.float32) * 2 + 1)
+
+    def test_matmul_row_major_readback(self, host):
+        import jax
+        import jax.numpy as jnp
+
+        from tensorframes_tpu.runtime.pjrt_host import stablehlo_for
+
+        a = np.random.RandomState(0).rand(16, 32).astype(np.float32)
+        b = np.random.RandomState(1).rand(32, 8).astype(np.float32)
+        mlir = stablehlo_for(
+            lambda p, q: jnp.matmul(p, q, precision=jax.lax.Precision.HIGHEST),
+            jnp.zeros_like(a),
+            jnp.zeros_like(b),
+        )
+        exe = host.compile(mlir)
+        (mm,) = exe(a, b, out_specs=[((16, 8), np.float32)])
+        np.testing.assert_allclose(mm, a @ b, rtol=1e-4)
+
+    def test_verbs_through_native_executor(self, host):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu.runtime.native_executor import NativeExecutor
+
+        ex = NativeExecutor.__new__(NativeExecutor)
+        ex.host = host
+        ex._cache = {}
+        ex.compile_count = 0
+
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(6, dtype=np.float32)}, num_blocks=2
+        )
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        out = tfs.map_blocks(z, df, executor=ex)
+        np.testing.assert_array_equal(
+            np.asarray(out["z"].values), np.arange(6.0, dtype=np.float32) + 3
+        )
+        assert ex.compile_count >= 1

@@ -3,5 +3,6 @@ frozen GraphDef-compatible scoring graphs)."""
 
 from .kmeans import kmeans
 from .mlp import MLP
+from .transformer import TransformerLM
 
-__all__ = ["MLP", "kmeans"]
+__all__ = ["MLP", "kmeans", "TransformerLM"]

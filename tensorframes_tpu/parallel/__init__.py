@@ -1,6 +1,17 @@
 """Distributed layer: device mesh + collective verbs (replaces Spark)."""
 
 from .mesh import Mesh, P, data_mesh, mesh_2d, shard_to_mesh
+from .ring import full_attention, ring_attention, seq_all_to_all
 from . import verbs
 
-__all__ = ["Mesh", "P", "data_mesh", "mesh_2d", "shard_to_mesh", "verbs"]
+__all__ = [
+    "Mesh",
+    "P",
+    "data_mesh",
+    "mesh_2d",
+    "shard_to_mesh",
+    "verbs",
+    "ring_attention",
+    "full_attention",
+    "seq_all_to_all",
+]

@@ -42,6 +42,8 @@ from .api import (
 from .graph import Graph, ShapeHints
 from .graph import builder as dsl
 from .runtime import Executor
+from . import config
+from . import utils
 
 __all__ = [
     "Column",

@@ -71,18 +71,23 @@ def _is_pandas(obj) -> bool:
 
 
 def _pandas_in_out(verb):
-    """Accept a pandas DataFrame wherever a TensorFrame is expected and
-    return pandas back — the reference's local-debug path
-    (`_map_pd`, `core.py:171-183`, dispatch `:263-265`, `:311-313`)."""
+    """Verb wrapper: pandas in/out (the reference's local-debug path,
+    `_map_pd`, `core.py:171-183`) + execution stats recording
+    (`utils.profiling.record`)."""
     import functools
+
+    from .utils.profiling import record
 
     @functools.wraps(verb)
     def wrapper(fetches, frame, *args, **kwargs):
         if _is_pandas(frame):
             tf_frame = TensorFrame.from_pandas(frame)
-            out = verb(fetches, tf_frame, *args, **kwargs)
+            with record(verb.__name__, tf_frame.nrows):
+                out = verb(fetches, tf_frame, *args, **kwargs)
             return out.to_pandas() if isinstance(out, TensorFrame) else out
-        return verb(fetches, frame, *args, **kwargs)
+        rows = frame.nrows if isinstance(frame, TensorFrame) else 0
+        with record(verb.__name__, rows):
+            return verb(fetches, frame, *args, **kwargs)
 
     return wrapper
 

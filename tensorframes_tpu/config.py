@@ -1,0 +1,81 @@
+"""Config layer: the knobs the reference hardcoded or lacked entirely.
+
+SURVEY.md §5 flags the reference's config story as "essentially none"
+(hardcoded UDAF buffer size, graph-to-file flag) and calls for a real
+layer: mesh shape, dtype policy, block sizing, compilation cache. This
+module is that layer — a process-global `Config` with scoped overrides::
+
+    tfs.config.update(matmul_precision="default")   # fast MXU bf16 passes
+    with tfs.config.override(default_num_blocks=16):
+        ...
+
+Knobs:
+- ``matmul_precision``: "highest" (default — numerical parity with the
+  reference's fp32 TF kernels) | "default" (MXU-native bf16 passes) |
+  "tensorfloat32". Consumed by the MatMul/Conv lowerings.
+- ``default_num_blocks``: blocks for frames built without an explicit
+  partitioning (None = single block).
+- ``default_mesh``: mesh used by verbs when ``mesh=`` is omitted
+  (None = single device).
+- ``compilation_cache_dir``: enables JAX's persistent compilation cache
+  (survives process restarts — the reference re-imported its graph into
+  a fresh TF session per task, `DebugRowOps.scala:790`).
+- ``aggregate_buffer_rows``: host-side group batching threshold (the
+  reference's hardcoded ``bufferSize=10``, `DebugRowOps.scala:580`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+__all__ = ["Config", "get", "update", "override"]
+
+
+@dataclasses.dataclass
+class Config:
+    matmul_precision: str = "highest"
+    default_num_blocks: Optional[int] = None
+    default_mesh: Optional[object] = None
+    compilation_cache_dir: Optional[str] = None
+    aggregate_buffer_rows: int = 10
+
+    def lax_precision(self):
+        from jax import lax
+
+        return {
+            "highest": lax.Precision.HIGHEST,
+            "tensorfloat32": lax.Precision.HIGH,
+            "default": lax.Precision.DEFAULT,
+        }[self.matmul_precision]
+
+
+_config = Config()
+
+
+def get() -> Config:
+    return _config
+
+
+def update(**kwargs) -> None:
+    for k, v in kwargs.items():
+        if not hasattr(_config, k):
+            raise AttributeError(f"unknown config key {k!r}")
+        setattr(_config, k, v)
+    if "compilation_cache_dir" in kwargs and kwargs["compilation_cache_dir"]:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", kwargs["compilation_cache_dir"]
+        )
+
+
+@contextlib.contextmanager
+def override(**kwargs):
+    old = {k: getattr(_config, k) for k in kwargs}
+    update(**kwargs)
+    try:
+        yield _config
+    finally:
+        update(**old)

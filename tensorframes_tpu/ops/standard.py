@@ -280,8 +280,11 @@ def _matmul(ctx, node, inputs):
     if tb:
         b = jnp.swapaxes(b, -1, -2)
     # TF float32 matmul is true fp32; JAX's default lets the MXU use bf16
-    # passes. Request HIGHEST for numerical parity with the reference.
-    return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+    # passes. Default is HIGHEST for numerical parity with the reference;
+    # config.matmul_precision="default" opts into MXU-native speed.
+    from .. import config
+
+    return jnp.matmul(a, b, precision=config.get().lax_precision())
 
 
 @register("L2Loss")
@@ -507,10 +510,12 @@ def _conv2d(ctx, node, inputs):
     if dil is not None:
         d = [int(v) for v in dil.value.i]
         rhs_dilation = d[1:3] if fmt == "NHWC" else d[2:4]
+    from .. import config
+
     return lax.conv_general_dilated(
         x, w, window_strides, _padding_str(node),
         rhs_dilation=rhs_dilation, dimension_numbers=dn,
-        precision=lax.Precision.HIGHEST,
+        precision=config.get().lax_precision(),
     )
 
 

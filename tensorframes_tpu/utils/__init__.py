@@ -1,0 +1,16 @@
+"""Auxiliary subsystems: profiling, checkpointing."""
+
+from .checkpoint import load_frame, load_params, save_frame, save_params
+from .profiling import annotate, record, reset_stats, stats, trace
+
+__all__ = [
+    "load_frame",
+    "load_params",
+    "save_frame",
+    "save_params",
+    "annotate",
+    "record",
+    "reset_stats",
+    "stats",
+    "trace",
+]

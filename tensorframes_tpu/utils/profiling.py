@@ -1,0 +1,90 @@
+"""Tracing / profiling subsystem.
+
+The reference had none (SURVEY.md §5: vendored `StepStats` protos that
+nothing consumed; debug logging only). Here profiling is first-class and
+rides the XLA/PJRT profiler:
+
+- ``trace(logdir)``: context manager around `jax.profiler` — captures
+  device traces (TensorBoard / xprof format) of everything inside,
+  including per-op device timings from the PJRT plugin.
+- ``annotate(name)``: named region that shows up on the trace timeline
+  (wraps `jax.profiler.TraceAnnotation`).
+- ``ExecStats``: lightweight process-global counters (compiles, verb
+  calls, rows processed, wall time per verb) — the `explain`-style
+  observability layer; read with `stats()`, reset with `reset_stats()`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["trace", "annotate", "record", "stats", "reset_stats"]
+
+
+class ExecStats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = defaultdict(float)
+
+    def add(self, key: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[key] += value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+
+
+_stats = ExecStats()
+
+
+def stats() -> Dict[str, float]:
+    """Process-global execution counters."""
+    return _stats.snapshot()
+
+
+def reset_stats() -> None:
+    _stats.reset()
+
+
+@contextlib.contextmanager
+def record(verb: str, rows: int = 0):
+    """Time one verb invocation into the stats registry."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _stats.add(f"{verb}.calls")
+        _stats.add(f"{verb}.seconds", dt)
+        if rows:
+            _stats.add(f"{verb}.rows", rows)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture an XLA/PJRT device trace into ``logdir``."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region on the profiler timeline."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

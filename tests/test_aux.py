@@ -1,0 +1,108 @@
+"""Aux subsystems: config, profiling stats, checkpoint/resume."""
+
+import os
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config
+from tensorframes_tpu.utils import (
+    load_frame,
+    load_params,
+    reset_stats,
+    save_frame,
+    save_params,
+    stats,
+)
+
+
+class TestConfig:
+    def test_defaults(self):
+        assert config.get().matmul_precision == "highest"
+        assert config.get().aggregate_buffer_rows == 10
+
+    def test_override_scoped(self):
+        with config.override(matmul_precision="default"):
+            assert config.get().matmul_precision == "default"
+            from jax import lax
+
+            assert config.get().lax_precision() == lax.Precision.DEFAULT
+        assert config.get().matmul_precision == "highest"
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(AttributeError):
+            config.update(nonsense=1)
+
+
+class TestStats:
+    def test_verb_counters(self):
+        reset_stats()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(5.0)})
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        tfs.map_blocks(z, df)
+        s = stats()
+        assert s["map_blocks.calls"] == 1
+        assert s["map_blocks.rows"] == 5
+        assert s["map_blocks.seconds"] > 0
+
+
+class TestCheckpoint:
+    def test_frame_roundtrip(self, tmp_path):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "x": np.arange(6.0),
+                "v": [np.arange(2.0), np.arange(3.0)] * 3,
+            },
+            num_blocks=3,
+        )
+        p = str(tmp_path / "frame.npz")
+        save_frame(p, df)
+        back = load_frame(p)
+        assert back.offsets == df.offsets
+        assert back.columns == df.columns
+        np.testing.assert_array_equal(back["x"].values, df["x"].values)
+        assert not back["v"].is_dense
+        np.testing.assert_array_equal(back["v"].row(1), [0.0, 1.0, 2.0])
+
+    def test_device_frame_roundtrip(self, tmp_path):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)}).to_device()
+        p = str(tmp_path / "dev.npz")
+        save_frame(p, df)
+        back = load_frame(p)
+        np.testing.assert_array_equal(np.asarray(back["x"].values), np.arange(4.0))
+
+    def test_params_roundtrip_orbax(self, tmp_path):
+        from tensorframes_tpu.models import MLP
+
+        m = MLP([4, 8, 2], seed=0)
+        p = str(tmp_path / "ckpt")
+        save_params(p, m.params)
+        like = [(np.zeros_like(w), np.zeros_like(b)) for w, b in m.params]
+        back = load_params(p, like)
+        np.testing.assert_array_equal(
+            np.asarray(back[0][0]), np.asarray(m.params[0][0])
+        )
+
+    def test_resume_training(self, tmp_path):
+        # the actual resume story: train, checkpoint, restore, continue
+        import jax
+        import jax.numpy as jnp
+
+        from tensorframes_tpu.models import MLP
+
+        m = MLP([4, 8, 2], seed=0)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(16, 4), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 2, 16))
+        step = jax.jit(lambda p, x, y: m.train_step(p, x, y, lr=0.1))
+        params = m.params
+        for _ in range(3):
+            params, loss = step(params, x, y)
+        ck = str(tmp_path / "resume")
+        save_params(ck, params)
+        like = [(np.zeros_like(w), np.zeros_like(b)) for w, b in params]
+        restored = load_params(ck, like)
+        p1, l1 = step(params, x, y)
+        p2, l2 = step(restored, x, y)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)

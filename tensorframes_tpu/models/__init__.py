@@ -1,8 +1,9 @@
 """Model zoo: framework-native models in both forms (trainable JAX +
 frozen GraphDef-compatible scoring graphs)."""
 
+from .inception import InceptionLite
 from .kmeans import kmeans
 from .mlp import MLP
 from .transformer import TransformerLM
 
-__all__ = ["MLP", "kmeans", "TransformerLM"]
+__all__ = ["MLP", "kmeans", "TransformerLM", "InceptionLite"]

@@ -159,3 +159,54 @@ class TestTransformerLM:
             if first is None:
                 first = float(loss)
         assert float(loss) < first
+
+
+class TestInceptionLite:
+    def test_graphdef_scoring_over_image_frame(self):
+        # BASELINE config #5: frozen Inception GraphDef scoring over an
+        # image-tensor frame, through the wire-bytes interchange path.
+        from tensorframes_tpu.models.inception import InceptionLite
+        from tensorframes_tpu import dsl as _dsl
+
+        model = InceptionLite(image_size=16, width=4, num_classes=5, seed=0)
+        g, fetches = _dsl.build(model.scoring_graph("images"))
+        wire = g.to_bytes()
+        assert len(wire) > 1000  # real frozen weights inside
+
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(6, 16, 16, 3).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"images": imgs}, num_blocks=2)
+        out = tfs.map_blocks(wire, df, fetch_names=fetches, trim=True)
+        probs = out["probs"].values
+        assert probs.shape == (6, 5)
+        np.testing.assert_allclose(np.asarray(probs).sum(1), 1.0, rtol=1e-5)
+        # different images -> different distributions (weights not degenerate)
+        assert np.std(np.asarray(probs), axis=0).max() > 1e-6
+
+    def test_tf_session_parity(self):
+        # run the SAME frozen GraphDef through real TensorFlow and compare
+        tf1 = pytest.importorskip("tensorflow.compat.v1")
+        tf1.disable_eager_execution()
+        from tensorframes_tpu.models.inception import InceptionLite
+        from tensorframes_tpu import dsl as _dsl
+
+        model = InceptionLite(image_size=16, width=4, num_classes=5, seed=1)
+        g, fetches = _dsl.build(model.scoring_graph("images"))
+        wire = g.to_bytes()
+
+        rng = np.random.RandomState(1)
+        imgs = rng.rand(3, 16, 16, 3).astype(np.float32)
+
+        tf_graph = tf1.Graph()
+        with tf_graph.as_default():
+            gd = tf1.GraphDef()
+            gd.ParseFromString(wire)
+            tf1.import_graph_def(gd, name="")
+        with tf1.Session(graph=tf_graph) as sess:
+            theirs = sess.run(fetches[0] + ":0", {"images:0": imgs})
+
+        df = tfs.TensorFrame.from_dict({"images": imgs})
+        out = tfs.map_blocks(wire, df, fetch_names=fetches, trim=True)
+        np.testing.assert_allclose(
+            np.asarray(out["probs"].values), theirs, rtol=1e-4, atol=1e-6
+        )

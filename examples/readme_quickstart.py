@@ -1,0 +1,36 @@
+"""The reference README's quickstart, on this framework.
+
+TensorFrames (README.md):
+    df = sqlContext.createDataFrame(...)
+    x = tfs.block(df, "x")
+    z = tf.add(x, 3, name='z')
+    df2 = tfs.map_blocks(z, df)
+
+Here: same verbs, graphs built with the builder DSL (or imported
+GraphDefs, or plain Python functions), executed by XLA.
+"""
+
+import numpy as np
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+
+# --- map_blocks: x + 3 ---------------------------------------------------
+df = tfs.TensorFrame.from_dict({"x": np.array([1.0, 2.0, 3.0])})
+x = tfs.block(df, "x")
+z = (x + 3.0).named("z")
+df2 = tfs.map_blocks(z, df)
+print(df2.to_pandas())
+
+# --- analyze + vector reduce_sum / reduce_min ---------------------------
+data = [np.arange(3.0) + i for i in range(10)]
+df3 = tfs.analyze(tfs.TensorFrame.from_dict({"y": data}, num_blocks=3))
+y_input = tfs.block(df3, "y", tf_name="y_input")
+y_sum = dsl.reduce_sum(y_input, axes=[0]).named("y")
+print("sum:", tfs.reduce_blocks(y_sum, df3))
+y_min = dsl.reduce_min(y_input, axes=[0]).named("y")
+print("min:", tfs.reduce_blocks(y_min, df3))
+
+# --- the same thing as a plain Python function (TPU-native front-end) ---
+df4 = tfs.map_blocks(lambda x: {"z": x * x}, df)
+print(df4.to_pandas())

@@ -20,12 +20,6 @@ def main(rows: int, dim: int, use_mesh: bool):
     data = rng.rand(rows, dim).astype(np.float32)
     df = tfs.TensorFrame.from_dict({"v": data}, num_blocks=8)
 
-    v_input = tfs.block(df, "v", tf_name="v_input")
-    s = dsl.reduce_sum(v_input, axes=[0]).named("v")
-    sq_in = tfs.block(df, "v", tf_name="vsq_input")
-    # naming convention: output 'vsq' re-feeds placeholder 'vsq_input'
-    sq = dsl.reduce_sum(dsl.square(sq_in), axes=[0]).named("vsq")
-
     mesh = None
     if use_mesh:
         from tensorframes_tpu.parallel import data_mesh
@@ -33,10 +27,18 @@ def main(rows: int, dim: int, use_mesh: bool):
         mesh = data_mesh()
 
     t0 = time.perf_counter()
-    total = tfs.reduce_blocks(s, df, mesh=mesh)
-    total_sq = tfs.reduce_blocks(
-        sq, df, feed_dict={"vsq_input": "v"}, mesh=mesh
-    )
+    # A reduce_blocks graph must be associative: the SAME graph re-runs on
+    # stacked partials (reference: performReduceBlock pairwise merges).
+    # Sum(Square(x)) would square the partials again — so map the squares
+    # first, then reduce both columns with pure sums.
+    v = tfs.block(df, "v")
+    squared = tfs.map_blocks(dsl.square(v).named("vsq"), df, mesh=mesh)
+    v_input = tfs.block(squared, "v", tf_name="v_input")
+    s = dsl.reduce_sum(v_input, axes=[0]).named("v")
+    sq_input = tfs.block(squared, "vsq", tf_name="vsq_input")
+    sq = dsl.reduce_sum(sq_input, axes=[0]).named("vsq")
+    total = tfs.reduce_blocks(s, squared, mesh=mesh)
+    total_sq = tfs.reduce_blocks(sq, squared, mesh=mesh)
     dt = time.perf_counter() - t0
 
     mean = np.asarray(total) / rows

@@ -42,13 +42,22 @@ def main():
     out = tfs.map_blocks(z, df)
     assert float(np.asarray(out["z"].values[1])) == 4.0
 
-    iters = 5
+    # Steady-state pipeline: each iteration's output column feeds the next
+    # map (the chained-verb pattern device frames are designed for). One
+    # sync at the end — per-iteration host syncs would measure tunnel RTT,
+    # not framework throughput.
+    iters = 10
+    from tensorframes_tpu.frame import Column
+
     t0 = time.perf_counter()
+    cur = df
     for _ in range(iters):
-        out = tfs.map_blocks(z, df)
-        jax.block_until_ready(out["z"].values)
+        out = tfs.map_blocks(z, cur)
+        cur = tfs.TensorFrame([Column("x", out["z"].values)])
+    jax.block_until_ready(cur["x"].values)
     t1 = time.perf_counter()
     rows_per_sec = n * iters / (t1 - t0)
+    assert float(np.asarray(cur["x"].values[1])) == 1.0 + 3.0 * iters
 
     vs = None
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")

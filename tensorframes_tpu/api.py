@@ -329,7 +329,10 @@ def map_blocks(
             out_sizes.append(0)
             continue  # empty block: contributes nothing (the reference's
             # empty-partition TODO, `DebugRowOps.scala:386-387`)
-        feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
+        feeds = [
+            v if (lo == 0 and hi == frame.nrows) else v[lo:hi]
+            for v in (frame.column(mapping[n]).values for n in feed_names)
+        ]
         outs = fn(*feeds)
         bsize = None
         for f, o in zip(fetch_list, outs):

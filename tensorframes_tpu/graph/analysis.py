@@ -81,6 +81,9 @@ def _concretize(shape: Shape, probe: int) -> tuple:
     return tuple(probe if d is None else d for d in shape.dims)
 
 
+_analysis_cache: Dict[tuple, GraphSummary] = {}
+
+
 def analyze_graph(
     graph: Graph,
     fetches: Sequence[str],
@@ -91,7 +94,24 @@ def analyze_graph(
 
     ``placeholder_shapes`` overrides placeholder shape attrs (used by the
     verbs to inject column block shapes before validation).
+
+    Results are memoized on (graph fingerprint, fetches, overrides,
+    hints): analysis is pure, and re-deriving it per verb call would
+    dominate small-block dispatch (two abstract traces per call).
     """
+    cache_key = (
+        graph.fingerprint(),
+        tuple(fetches),
+        tuple(sorted(
+            (k, v.dims) for k, v in (placeholder_shapes or {}).items()
+        )),
+        tuple(sorted(
+            (k, v.dims) for k, v in (hints.out_shapes if hints else {}).items()
+        )),
+    )
+    cached = _analysis_cache.get(cache_key)
+    if cached is not None:
+        return cached
     hints = hints or ShapeHints()
     overrides = dict(placeholder_shapes or {})
     phs = graph.placeholders()
@@ -139,4 +159,8 @@ def analyze_graph(
         dtype = ScalarType.from_np_dtype(np.dtype(a.dtype))
         outputs[base] = NodeSummary(base, False, True, dtype, merged)
 
-    return GraphSummary(inputs=inputs, outputs=outputs)
+    summary = GraphSummary(inputs=inputs, outputs=outputs)
+    if len(_analysis_cache) > 1024:  # bound the cache
+        _analysis_cache.clear()
+    _analysis_cache[cache_key] = summary
+    return summary

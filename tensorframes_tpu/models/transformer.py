@@ -78,6 +78,12 @@ class TransformerLM:
             att = jax.vmap(
                 lambda a, b, c: ring_attention(a, b, c, mesh, causal=True)
             )(qh, kh, vh)
+        elif jax.default_backend() == "tpu":
+            from ..ops.pallas_kernels import flash_attention
+
+            att = jax.vmap(
+                lambda a, b, c: flash_attention(a, b, c, causal=True)
+            )(qh, kh, vh)
         else:
             att = jax.vmap(
                 lambda a, b, c: full_attention(a, b, c, causal=True)

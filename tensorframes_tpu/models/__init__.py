@@ -4,6 +4,7 @@ frozen GraphDef-compatible scoring graphs)."""
 from .inception import InceptionLite
 from .kmeans import kmeans
 from .mlp import MLP
+from .moe import MoEFFN
 from .transformer import TransformerLM
 
-__all__ = ["MLP", "kmeans", "TransformerLM", "InceptionLite"]
+__all__ = ["MLP", "kmeans", "TransformerLM", "InceptionLite", "MoEFFN"]

@@ -1,0 +1,111 @@
+"""Mixture-of-Experts FFN with expert parallelism over the mesh.
+
+Experts shard over a mesh axis (each device owns E/ndev experts); a
+token's output is the gate-weighted sum of its top-k experts' FFNs, and
+the cross-device combine is a single `psum` over the expert axis.
+
+This implementation uses dense masked dispatch (every shard evaluates
+its local experts over the full token set, masked by the routing
+weights): numerically exact, simple, and collective-light (one psum).
+The capacity-based `all_to_all` dispatch that avoids the masked compute
+is the optimization path (see `parallel.ring.seq_all_to_all` for the
+primitive it would build on).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["MoEFFN"]
+
+
+class MoEFFN:
+    """Top-k gated expert FFNs: x -> sum_k gate_k * FFN_{e_k}(x)."""
+
+    def __init__(
+        self,
+        d_model: int = 32,
+        d_hidden: int = 64,
+        num_experts: int = 8,
+        top_k: int = 2,
+        seed: int = 0,
+    ):
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.num_experts, self.top_k = num_experts, top_k
+        key = jax.random.PRNGKey(seed)
+        kg, k1, k2 = jax.random.split(key, 3)
+        s1 = 1.0 / np.sqrt(d_model)
+        s2 = 1.0 / np.sqrt(d_hidden)
+        self.params = {
+            "gate": jax.random.normal(kg, (d_model, num_experts), jnp.float32) * s1,
+            "w1": jax.random.normal(
+                k1, (num_experts, d_model, d_hidden), jnp.float32
+            ) * s1,
+            "w2": jax.random.normal(
+                k2, (num_experts, d_hidden, d_model), jnp.float32
+            ) * s2,
+        }
+
+    def _route(self, params, x):
+        """Top-k softmax routing weights, (tokens, experts), rows sum to 1
+        over the selected experts."""
+        logits = x @ params["gate"]  # (N, E)
+        topv, topi = lax.top_k(logits, self.top_k)
+        gates = jax.nn.softmax(topv, axis=-1)  # (N, k)
+        dense = jnp.zeros_like(logits)
+        for k in range(self.top_k):
+            dense = dense.at[jnp.arange(x.shape[0]), topi[:, k]].add(
+                gates[:, k]
+            )
+        return dense  # (N, E) with <=k nonzeros per row
+
+    @staticmethod
+    def _expert_ffn(w1, w2, x):
+        return jax.nn.gelu(x @ w1) @ w2
+
+    def apply(self, params, x):
+        """Single-device reference: evaluate all experts densely."""
+        weights = self._route(params, x)  # (N, E)
+        outs = jax.vmap(self._expert_ffn, in_axes=(0, 0, None))(
+            params["w1"], params["w2"], x
+        )  # (E, N, d)
+        return jnp.einsum("ne,end->nd", weights, outs)
+
+    def apply_ep(self, params, x, mesh: Mesh, axis: str = "model"):
+        """Expert-parallel: experts sharded over ``axis``; one psum."""
+        n_shard = mesh.shape[axis]
+        if self.num_experts % n_shard:
+            raise ValueError(
+                f"num_experts {self.num_experts} must divide the "
+                f"{axis!r} axis size {n_shard}"
+            )
+
+        def shard_body(w1, w2, gate, xs):
+            weights = self._route({"gate": gate}, xs)  # (N, E) full routing
+            shard = lax.axis_index(axis)
+            e_per = self.num_experts // n_shard
+            # this shard's slice of the routing matrix
+            local_w = lax.dynamic_slice_in_dim(
+                weights, shard * e_per, e_per, axis=1
+            )  # (N, e_per)
+            outs = jax.vmap(self._expert_ffn, in_axes=(0, 0, None))(
+                w1, w2, xs
+            )  # (e_per, N, d)
+            local = jnp.einsum("ne,end->nd", local_w, outs)
+            return lax.psum(local, axis)
+
+        espec = P(axis)
+        return shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(espec, espec, P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(params["w1"], params["w2"], params["gate"], x)

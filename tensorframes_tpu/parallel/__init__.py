@@ -1,6 +1,7 @@
 """Distributed layer: device mesh + collective verbs (replaces Spark)."""
 
 from .mesh import Mesh, P, data_mesh, mesh_2d, shard_to_mesh
+from .pipeline import pipeline_apply
 from .ring import full_attention, ring_attention, seq_all_to_all
 from . import verbs
 
@@ -14,4 +15,5 @@ __all__ = [
     "ring_attention",
     "full_attention",
     "seq_all_to_all",
+    "pipeline_apply",
 ]

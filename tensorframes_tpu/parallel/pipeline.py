@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe-style staged execution over a mesh axis.
+
+Each device on the ``stage`` axis holds ONE stage's parameters; a batch
+is split into microbatches that flow through the ring of stages with
+`lax.ppermute` handing activations to the next stage over ICI. The
+steady-state schedule keeps every stage busy: with S stages and M
+microbatches the pipeline runs M + S - 1 ticks (the classic bubble).
+
+Built entirely from shard_map + collectives — no per-stage host
+processes. Composes with the data axis (run inside an outer shard_map)
+and with TP inside a stage.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "stage",
+    num_microbatches: int,
+):
+    """Run ``stage_fn`` as a pipeline over the mesh's ``axis``.
+
+    - ``stage_fn(params, h) -> h``: one stage's computation (same
+      signature on every stage; heterogeneous behavior goes in params).
+    - ``stage_params``: pytree whose leaves have a leading stage axis of
+      size = mesh.shape[axis]; leaf s lives on stage s.
+    - ``x``: (batch, ...) activations; batch must divide
+      ``num_microbatches``.
+
+    Returns stage S-1's outputs for the whole batch.
+    """
+    n_stage = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} must divide num_microbatches {num_microbatches}"
+        )
+    mb = batch // num_microbatches
+    ticks = num_microbatches + n_stage - 1
+
+    def shard_body(params, xs):
+        # params: this stage's slice (leading axis stripped by shard_map)
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        perm = [(j, (j + 1) % n_stage) for j in range(n_stage)]
+
+        def tick(carry, t):
+            buf, out = carry
+            # stage 0 injects microbatch t (while t < num_microbatches)
+            inject = jnp.clip(t, 0, num_microbatches - 1)
+            fresh = lax.dynamic_slice_in_dim(xs, inject * mb, mb, axis=0)
+            h_in = jnp.where(stage == 0, fresh, buf)
+            h_out = stage_fn(params, h_in)
+            # last stage records its finished microbatch (t - n_stage + 1)
+            done_idx = t - (n_stage - 1)
+            out = lax.cond(
+                done_idx >= 0,
+                lambda o: lax.dynamic_update_slice_in_dim(
+                    o, h_out, jnp.maximum(done_idx, 0) * mb, axis=0
+                ),
+                lambda o: o,
+                out,
+            )
+            # hand activations to the next stage around the ring
+            buf = lax.ppermute(h_out, axis, perm)
+            return (buf, out), None
+
+        buf0 = jnp.zeros((mb,) + xs.shape[1:], xs.dtype)
+        out0 = jnp.zeros_like(xs)
+        (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(ticks))
+        # only the LAST stage's `out` is the real result; broadcast it.
+        # psum of (out where last stage else 0) replicates it everywhere.
+        is_last = (stage == n_stage - 1).astype(out.dtype)
+        return lax.psum(out * is_last, axis)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    return shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(pspec, P()),  # activations replicated in, result out
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x)

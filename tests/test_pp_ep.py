@@ -1,0 +1,107 @@
+"""Pipeline parallelism and expert parallelism on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import tensorframes_tpu  # noqa: F401  (x64 + config)
+from tensorframes_tpu.models.moe import MoEFFN
+from tensorframes_tpu.parallel.pipeline import pipeline_apply
+
+
+@pytest.fixture(scope="module")
+def stage_mesh():
+    return Mesh(np.asarray(jax.devices()[:4]), ("stage",))
+
+
+class TestPipeline:
+    def _stages(self, n_stage, d, seed=0):
+        rng = np.random.RandomState(seed)
+        # one linear+relu stage per device
+        w = jnp.asarray(rng.randn(n_stage, d, d) / np.sqrt(d), jnp.float32)
+        b = jnp.asarray(rng.randn(n_stage, d) * 0.1, jnp.float32)
+        params = {"w": w, "b": b}
+
+        def stage_fn(p, h):
+            return jax.nn.relu(h @ p["w"] + p["b"])
+
+        def sequential(x):
+            h = x
+            for s in range(n_stage):
+                h = jax.nn.relu(h @ w[s] + b[s])
+            return h
+
+        return params, stage_fn, sequential
+
+    def test_matches_sequential(self, stage_mesh):
+        params, stage_fn, sequential = self._stages(4, 8)
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(16, 8), jnp.float32
+        )
+        out = pipeline_apply(
+            stage_fn, params, x, stage_mesh, num_microbatches=4
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(sequential(x)), rtol=2e-5, atol=1e-6
+        )
+
+    def test_microbatch_one(self, stage_mesh):
+        params, stage_fn, sequential = self._stages(4, 4, seed=2)
+        x = jnp.asarray(np.random.RandomState(2).randn(6, 4), jnp.float32)
+        out = pipeline_apply(
+            stage_fn, params, x, stage_mesh, num_microbatches=1
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(sequential(x)), rtol=2e-5, atol=1e-6
+        )
+
+    def test_bad_microbatch_count(self, stage_mesh):
+        params, stage_fn, _ = self._stages(4, 4)
+        x = jnp.zeros((10, 4), jnp.float32)
+        with pytest.raises(ValueError, match="microbatches"):
+            pipeline_apply(stage_fn, params, x, stage_mesh, num_microbatches=3)
+
+    def test_jit_and_grad(self, stage_mesh):
+        params, stage_fn, sequential = self._stages(4, 4, seed=3)
+        x = jnp.asarray(np.random.RandomState(3).randn(8, 4), jnp.float32)
+
+        def loss(p):
+            return jnp.sum(
+                pipeline_apply(stage_fn, p, x, stage_mesh, num_microbatches=2)
+                ** 2
+            )
+
+        g = jax.jit(jax.grad(loss))(params)
+        assert np.isfinite(float(jnp.sum(g["w"])))
+
+
+class TestMoE:
+    def test_ep_matches_dense(self):
+        from tensorframes_tpu.parallel import data_mesh, mesh_2d
+
+        mesh = Mesh(np.asarray(jax.devices()), ("model",))
+        moe = MoEFFN(d_model=16, d_hidden=32, num_experts=8, top_k=2, seed=0)
+        x = jnp.asarray(np.random.RandomState(0).randn(24, 16), jnp.float32)
+        dense = moe.apply(moe.params, x)
+        ep = moe.apply_ep(moe.params, x, mesh, axis="model")
+        np.testing.assert_allclose(
+            np.asarray(ep), np.asarray(dense), rtol=2e-5, atol=1e-6
+        )
+
+    def test_routing_is_topk(self):
+        moe = MoEFFN(d_model=8, num_experts=8, top_k=2, seed=1)
+        x = jnp.asarray(np.random.RandomState(1).randn(10, 8), jnp.float32)
+        w = moe._route(moe.params, x)
+        nz = (np.asarray(w) > 0).sum(axis=1)
+        assert (nz <= 2).all() and (nz >= 1).all()
+        np.testing.assert_allclose(np.asarray(w).sum(1), 1.0, rtol=1e-6)
+
+    def test_indivisible_experts_rejected(self):
+        mesh = Mesh(np.asarray(jax.devices()[:3]), ("model",))
+        moe = MoEFFN(num_experts=8)
+        x = jnp.zeros((4, 32), jnp.float32)
+        with pytest.raises(ValueError, match="divide"):
+            moe.apply_ep(moe.params, x, mesh)

@@ -333,7 +333,14 @@ def map_blocks(
             v if (lo == 0 and hi == frame.nrows) else v[lo:hi]
             for v in (frame.column(mapping[n]).values for n in feed_names)
         ]
-        outs = fn(*feeds)
+        from . import config as _config
+        from .runtime.retry import run_with_retries
+
+        outs = run_with_retries(
+            fn, *feeds,
+            attempts=_config.get().block_retry_attempts,
+            what=f"map_blocks block {bi}",
+        )
         bsize = None
         for f, o in zip(fetch_list, outs):
             # keep device arrays on device; shape checks are metadata-only
@@ -898,3 +905,55 @@ def block(frame: TensorFrame, col_name: str, tf_name: Optional[str] = None):
 def row(frame: TensorFrame, col_name: str, tf_name: Optional[str] = None):
     """Row placeholder for a column (`tfs.row`)."""
     return dsl.row(frame, col_name, tf_name)
+
+
+# ---------------------------------------------------------------------------
+# fluent methods (the reference's Scala Implicits: RichDataFrame adds
+# df.mapBlocks(...)/df.mapRows/... and RichRelationalGroupedDataset adds
+# .aggregate — `dsl/Implicits.scala:25-124`)
+# ---------------------------------------------------------------------------
+
+
+def _install_fluent_methods() -> None:
+    def _map_blocks(self, fetches, **kw):
+        return map_blocks(fetches, self, **kw)
+
+    def _map_rows(self, fetches, **kw):
+        return map_rows(fetches, self, **kw)
+
+    def _reduce_blocks(self, fetches, **kw):
+        return reduce_blocks(fetches, self, **kw)
+
+    def _reduce_rows(self, fetches, **kw):
+        return reduce_rows(fetches, self, **kw)
+
+    def _group_by(self, *keys):
+        return GroupedFrame(self, keys)
+
+    _slice_block = TensorFrame.block
+
+    def _block(self, arg, tf_name=None):
+        # polymorphic like the reference's dual use: df.block(i) slices
+        # block i; df.block("col") builds a placeholder for the column
+        if isinstance(arg, str):
+            return dsl.block(self, arg, tf_name)
+        return _slice_block(self, arg)
+
+    def _row(self, col, tf_name=None):
+        return dsl.row(self, col, tf_name)
+
+    TensorFrame.map_blocks = _map_blocks
+    TensorFrame.map_rows = _map_rows
+    TensorFrame.reduce_blocks = _reduce_blocks
+    TensorFrame.reduce_rows = _reduce_rows
+    TensorFrame.group_by = _group_by
+    TensorFrame.block = _block
+    TensorFrame.row = _row
+
+    def _agg(self, fetches, **kw):
+        return aggregate(fetches, self, **kw)
+
+    GroupedFrame.aggregate = _agg
+
+
+_install_fluent_methods()

@@ -40,6 +40,8 @@ class Config:
     default_mesh: Optional[object] = None
     compilation_cache_dir: Optional[str] = None
     aggregate_buffer_rows: int = 10
+    # Spark-style blanket re-execution of failed block runs (pure fns).
+    block_retry_attempts: int = 0
 
     def lax_precision(self):
         from jax import lax

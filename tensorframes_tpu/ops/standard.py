@@ -201,19 +201,37 @@ def _clip(ctx, node, inputs):
 # ---------------------------------------------------------------------------
 
 
-def _make_reducer(jfn):
+def _make_reducer(jfn, keep_dtype=False):
     def rule(ctx, node, inputs):
         axes = _reduction_axes(ctx, node, inputs[0], inputs[1])
+        if keep_dtype:
+            # TF reductions keep the input dtype (no numpy-style int32 ->
+            # int64 accumulator promotion under x64).
+            dt = jnp.asarray(inputs[0]).dtype
+            return jfn(inputs[0], axis=axes, keepdims=_keep_dims(node), dtype=dt)
         return jfn(inputs[0], axis=axes, keepdims=_keep_dims(node))
 
     return rule
 
 
-register("Sum")(_make_reducer(jnp.sum))
-register("Prod")(_make_reducer(jnp.prod))
+@register("Mean")
+def _mean(ctx, node, inputs):
+    axes = _reduction_axes(ctx, node, inputs[0], inputs[1])
+    x = jnp.asarray(inputs[0])
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        # TF Mean on integers = integer division of sum by count
+        total = jnp.sum(x, axis=axes, keepdims=_keep_dims(node), dtype=x.dtype)
+        count = 1
+        for a in axes:
+            count *= x.shape[a]
+        return lax.div(total, jnp.asarray(count, x.dtype))
+    return jnp.mean(x, axis=axes, keepdims=_keep_dims(node))
+
+
+register("Sum")(_make_reducer(jnp.sum, keep_dtype=True))
+register("Prod")(_make_reducer(jnp.prod, keep_dtype=True))
 register("Min")(_make_reducer(jnp.min))
 register("Max")(_make_reducer(jnp.max))
-register("Mean")(_make_reducer(jnp.mean))
 register("All")(_make_reducer(jnp.all))
 register("Any")(_make_reducer(jnp.any))
 

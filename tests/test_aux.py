@@ -106,3 +106,72 @@ class TestCheckpoint:
         p1, l1 = step(params, x, y)
         p2, l2 = step(restored, x, y)
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+class TestFluentAPI:
+    def test_fluent_verbs(self):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        out = df.map_blocks((df.block("x") + 1.0).named("z"))
+        np.testing.assert_array_equal(out["z"].values, np.arange(4.0) + 1)
+        x_input = df.block("x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        assert float(df.reduce_blocks(s)) == 6.0
+
+    def test_fluent_groupby_aggregate(self):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict(
+            {"k": np.array([0, 0, 1], np.int64), "x": np.array([1.0, 2.0, 5.0])}
+        )
+        x_input = df.block("x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = df.group_by("k").aggregate(s)
+        got = dict(zip(out["k"].values.tolist(), out["x"].values.tolist()))
+        assert got == {0: 3.0, 1: 5.0}
+
+
+class TestRetry:
+    def test_flaky_block_recovers(self):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import config
+
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return {"y": x + 1.0}
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(3.0)})
+        with config.override(block_retry_attempts=2):
+            # function front-end doesn't use the retry path; use graph path
+            # with a monkeypatched executor callable
+            from tensorframes_tpu.runtime.retry import run_with_retries
+
+            out = run_with_retries(flaky, np.arange(3.0), attempts=2)
+        np.testing.assert_array_equal(out["y"], np.arange(3.0) + 1)
+        assert calls["n"] == 2
+
+    def test_exhausted_retries_raise(self):
+        from tensorframes_tpu.runtime.retry import run_with_retries
+
+        def always_fails():
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError, match="deterministic"):
+            run_with_retries(always_fails, attempts=2)
+
+
+class TestLogging:
+    def test_logger_level_env(self, monkeypatch):
+        import importlib
+
+        from tensorframes_tpu.utils import log as tlog
+
+        lg = tlog.get_logger("test")
+        assert lg.name == "tensorframes_tpu.test"

@@ -1,0 +1,74 @@
+"""Typed test matrix: dtype coverage multiplied over the core operations.
+
+The reference multiplies its test coverage over dtypes with an abstract
+suite + implicit converters (`CommonOperationsSuite[T]` instantiated for
+Int/Double/Float/Long in `type_suites.scala`); here pytest
+parametrization does the same job over the identity/monoid operations.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.schema import ScalarType, Shape
+
+DTYPES = [
+    (ScalarType.float64, np.float64),
+    (ScalarType.float32, np.float32),
+    (ScalarType.int32, np.int32),
+    (ScalarType.int64, np.int64),
+]
+
+
+@pytest.mark.parametrize("st,npdt", DTYPES, ids=[d[0].name for d in DTYPES])
+class TestTypedMatrix:
+    """BasicIdentityTests + BasicMonoidTests across the dtype matrix."""
+
+    def _frame(self, npdt, values=(1, 2, 3, 4, 5)):
+        return tfs.TensorFrame.from_dict(
+            {"x": np.asarray(values, dtype=npdt)}, num_blocks=2
+        )
+
+    def test_identity_map(self, st, npdt):
+        df = self._frame(npdt)
+        x = tfs.block(df, "x")
+        out = tfs.map_blocks(dsl.identity(x).named("y"), df)
+        assert out["y"].values.dtype == npdt
+        np.testing.assert_array_equal(out["y"].values, df["x"].values)
+
+    def test_add_constant(self, st, npdt):
+        df = self._frame(npdt)
+        x = tfs.block(df, "x")
+        out = tfs.map_blocks((x + npdt(3)).named("y"), df)
+        np.testing.assert_array_equal(out["y"].values, df["x"].values + 3)
+
+    def test_reduce_blocks_sum(self, st, npdt):
+        df = self._frame(npdt)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        res = tfs.reduce_blocks(s, df)
+        assert np.asarray(res) == 15
+        assert np.asarray(res).dtype == npdt
+
+    def test_reduce_blocks_min(self, st, npdt):
+        df = self._frame(npdt)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        res = tfs.reduce_blocks(
+            dsl.reduce_min(x_input, axes=[0]).named("x"), df
+        )
+        assert np.asarray(res) == 1
+
+    def test_reduce_rows_pairwise(self, st, npdt):
+        df = self._frame(npdt)
+        a = dsl.placeholder(st, Shape(()), name="x_1")
+        b = dsl.placeholder(st, Shape(()), name="x_2")
+        res = tfs.reduce_rows(dsl.add(a, b).named("x"), df)
+        assert np.asarray(res) == 15
+
+    def test_vector_cells(self, st, npdt):
+        vals = np.arange(12).reshape(6, 2).astype(npdt)
+        df = tfs.TensorFrame.from_dict({"v": vals}, num_blocks=3)
+        v = tfs.block(df, "v")
+        out = tfs.map_blocks((v * npdt(2)).named("w"), df)
+        np.testing.assert_array_equal(out["w"].values, vals * 2)

@@ -213,6 +213,85 @@ class TensorFrame:
         return cls.from_dict(data, num_blocks=num_blocks)
 
     @classmethod
+    def from_arrow(cls, table, num_blocks: Optional[int] = None) -> "TensorFrame":
+        """Build from a pyarrow Table: primitive columns become dense,
+        fixed-size-list columns dense vectors, list columns ragged. This
+        is the interchange path for Spark-style ingestion (Arrow IPC from
+        executor partitions; SURVEY.md §7.7's bridge)."""
+        import pyarrow as pa
+
+        data: Dict[str, ArrayLike] = {}
+        for name in table.column_names:
+            col = table.column(name).combine_chunks()
+            if pa.types.is_fixed_size_list(col.type):
+                width = col.type.list_size
+                flat = col.values.to_numpy(zero_copy_only=False)
+                data[name] = flat.reshape(-1, width)
+            elif pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+                data[name] = [
+                    np.asarray(x) for x in col.to_pylist()
+                ]
+            else:
+                data[name] = col.to_numpy(zero_copy_only=False)
+        return cls.from_dict(data, num_blocks=num_blocks)
+
+    def to_arrow(self):
+        """Export to a pyarrow Table (dense vectors as fixed-size lists,
+        ragged as lists)."""
+        import pyarrow as pa
+
+        arrays = []
+        names = []
+        for name in self.columns:
+            c = self.column(name)
+            names.append(name)
+            if c.is_dense and c.cell_shape.is_scalar:
+                arrays.append(pa.array(np.asarray(c.values)))
+            elif c.is_dense and c.cell_shape.rank == 1:
+                vals = np.asarray(c.values)
+                width = vals.shape[1]
+                arrays.append(
+                    pa.FixedSizeListArray.from_arrays(
+                        pa.array(vals.ravel()), width
+                    )
+                )
+            else:
+                arrays.append(
+                    pa.array([np.asarray(r).tolist() for r in c.rows()])
+                )
+        return pa.table(dict(zip(names, arrays)))
+
+    def pad_ragged(self, col_name: str, length_col: Optional[str] = None) -> "TensorFrame":
+        """Materialize a ragged rank-1 column as a zero-padded dense column
+        plus a length column — the masked-execution bridge for block-level
+        ops over variable-length rows (the reference ran these per-row,
+        `TFDataOps.scala:90-103`; padding + masks is the XLA-native way).
+        Uses the native pack kernel when built."""
+        c = self.column(col_name)
+        if c.is_dense:
+            return self
+        if c.cell_shape.rank != 1:
+            raise ValueError("pad_ragged supports rank-1 ragged columns")
+        from .native import pack_ragged
+
+        cells = [np.asarray(r) for r in c.rows()]
+        packed = pack_ragged(cells)
+        if packed is None:  # pure-python fallback
+            max_len = max(x.size for x in cells)
+            out = np.zeros((len(cells), max_len), dtype=cells[0].dtype)
+            lens = np.empty(len(cells), np.int32)
+            for i, x in enumerate(cells):
+                out[i, : x.size] = x
+                lens[i] = x.size
+        else:
+            out, lens = packed
+        new_cols = [
+            Column(col_name, out, c.dtype),
+            Column(length_col or f"{col_name}_len", lens),
+        ]
+        return self.with_columns(new_cols)
+
+    @classmethod
     def from_rows(
         cls,
         rows: Sequence[Dict[str, ArrayLike]],

@@ -459,6 +459,57 @@ def _strided_slice(ctx, node, inputs):
     return x[tuple(idx)]
 
 
+@register("Pad", "PadV2")
+def _pad(ctx, node, inputs):
+    paddings = ctx.static(inputs[1], node, "paddings")
+    const = inputs[2] if len(inputs) > 2 else 0
+    return jnp.pad(
+        jnp.asarray(inputs[0]),
+        [(int(a), int(b)) for a, b in paddings],
+        constant_values=const,
+    )
+
+
+@register("MirrorPad")
+def _mirror_pad(ctx, node, inputs):
+    paddings = ctx.static(inputs[1], node, "paddings")
+    mode = node.attr("mode", b"REFLECT")
+    mode = (mode.decode() if isinstance(mode, bytes) else mode).lower()
+    return jnp.pad(
+        jnp.asarray(inputs[0]),
+        [(int(a), int(b)) for a, b in paddings],
+        mode="reflect" if mode == "reflect" else "symmetric",
+    )
+
+
+@register("TopK", "TopKV2")
+def _top_k(ctx, node, inputs):
+    k = int(ctx.static(inputs[1], node, "k")) if len(inputs) > 1 else int(
+        node.attr("k", 1)
+    )
+    values, indices = lax.top_k(jnp.asarray(inputs[0]), k)
+    return (values, indices.astype(jnp.int32))
+
+
+@register("Cumsum")
+def _cumsum(ctx, node, inputs):
+    axis = int(ctx.static(inputs[1], node, "axis"))
+    x = jnp.asarray(inputs[0])
+    exclusive = bool(node.attr("exclusive", False))
+    reverse = bool(node.attr("reverse", False))
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis, dtype=x.dtype)
+    if exclusive:
+        out = jnp.roll(out, 1, axis)
+        idx = [slice(None)] * out.ndim
+        idx[axis] = 0
+        out = out.at[tuple(idx)].set(0)
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
 @register("GatherV2", "Gather")
 def _gather(ctx, node, inputs):
     axis = int(ctx.static(inputs[2], node, "axis")) if len(inputs) > 2 else 0

@@ -104,3 +104,59 @@ class TestTensorFrame:
     def test_from_rows(self):
         tf = TensorFrame.from_rows([{"x": 1.0}, {"x": 2.0}])
         assert tf.nrows == 2
+
+
+class TestArrowInterop:
+    def test_roundtrip(self):
+        import pyarrow as pa
+
+        tf = TensorFrame.from_dict(
+            {
+                "x": np.arange(4.0),
+                "v": np.arange(8.0).reshape(4, 2),
+                "r": [np.arange(1.0), np.arange(2.0), np.arange(3.0), np.arange(1.0)],
+            }
+        )
+        table = tf.to_arrow()
+        assert isinstance(table, pa.Table)
+        back = TensorFrame.from_arrow(table)
+        np.testing.assert_array_equal(back["x"].values, tf["x"].values)
+        np.testing.assert_array_equal(back["v"].values, tf["v"].values)
+        assert not back["r"].is_dense
+        np.testing.assert_array_equal(back["r"].row(2), [0.0, 1.0, 2.0])
+
+    def test_from_arrow_primitive(self):
+        import pyarrow as pa
+
+        t = pa.table({"a": pa.array([1, 2, 3], pa.int64())})
+        tf = TensorFrame.from_arrow(t, num_blocks=2)
+        assert tf.num_blocks == 2
+        np.testing.assert_array_equal(tf["a"].values, [1, 2, 3])
+
+
+class TestPadRagged:
+    def test_pad_and_lengths(self):
+        tf = TensorFrame.from_dict(
+            {"v": [np.arange(2.0), np.arange(4.0), np.arange(1.0)]}
+        )
+        padded = tf.pad_ragged("v")
+        assert padded["v"].is_dense
+        assert padded["v"].values.shape == (3, 4)
+        np.testing.assert_array_equal(padded["v_len"].values, [2, 4, 1])
+        np.testing.assert_array_equal(padded["v"].values[0], [0, 1, 0, 0])
+
+    def test_masked_block_op_over_padded(self):
+        # the intended use: masked mean per row over the padded block
+        import tensorframes_tpu as tfs
+
+        tf = TensorFrame.from_dict(
+            {"v": [np.arange(2.0) + 1, np.arange(4.0) + 1]}
+        ).pad_ragged("v")
+        out = tfs.map_blocks(
+            lambda v, v_len: {"m": v.sum(axis=1) / v_len}, tf
+        )
+        np.testing.assert_allclose(out["m"].values, [1.5, 2.5])
+
+    def test_dense_noop(self):
+        tf = TensorFrame.from_dict({"v": np.ones((3, 2))})
+        assert tf.pad_ragged("v") is tf

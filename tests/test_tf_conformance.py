@@ -227,3 +227,47 @@ class TestVariableFreezing:
         fn = build_callable(ours_graph, ["z"], ["x"])
         (ours,) = fn(xs)
         np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-5)
+
+
+class TestDtypeSemanticsParity:
+    def test_int32_sum_keeps_dtype(self):
+        def build(tf):
+            x = tf.placeholder(tf.int32, [None], name="x")
+            tf.reduce_sum(x, axis=[0], name="z")
+
+        assert_match(build, {"x": np.array([1, 2, 3], np.int32)}, "z")
+
+    def test_int32_mean_truncates(self):
+        def build(tf):
+            x = tf.placeholder(tf.int32, [None], name="x")
+            tf.reduce_mean(x, axis=[0], name="z")
+
+        assert_match(build, {"x": np.array([1, 2, 4], np.int32)}, "z")
+
+    def test_pad(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 3], name="x")
+            tf.pad(x, [[1, 0], [0, 2]], name="z")
+
+        assert_match(
+            build, {"x": np.arange(6, dtype=np.float32).reshape(2, 3)}, "z"
+        )
+
+    def test_cumsum(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None], name="x")
+            tf.cumsum(x, name="z")
+
+        assert_match(build, {"x": np.arange(5, dtype=np.float32)}, "z")
+
+    def test_topk_values(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 5], name="x")
+            vals, _ = tf.nn.top_k(x, k=2)
+            tf.identity(vals, name="z")
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(0).rand(3, 5).astype(np.float32)},
+            "z",
+        )

@@ -36,6 +36,7 @@ from .api import (
     map_rows,
     print_schema,
     reduce_blocks,
+    reduce_blocks_stream,
     reduce_rows,
     row,
 )
@@ -64,6 +65,7 @@ __all__ = [
     "map_rows",
     "print_schema",
     "reduce_blocks",
+    "reduce_blocks_stream",
     "reduce_rows",
     "row",
     "Graph",

@@ -52,6 +52,7 @@ __all__ = [
     "map_rows",
     "reduce_blocks",
     "reduce_rows",
+    "reduce_blocks_stream",
     "aggregate",
     "analyze",
     "print_schema",
@@ -630,6 +631,49 @@ def reduce_blocks(
     if len(fetch_list) == 1:
         return final[0]
     return {_base(f): v for f, v in zip(fetch_list, final)}
+
+
+def reduce_blocks_stream(
+    fetches: Fetches,
+    frames,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+    mesh=None,
+):
+    """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
+    hold at once — the Spark-spill analogue). Each chunk reduces on device
+    while the next stages; chunk partials combine with the same graph.
+
+    The streaming form is what makes the BASELINE north star (1B-row
+    vector reduce_sum) run in bounded host memory.
+    """
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    partials: List = []
+    for f in frames:
+        r = reduce_blocks(
+            graph, f, feed_dict, fetch_names=fetch_list,
+            executor=executor, mesh=mesh,
+        )
+        partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
+    if not partials:
+        raise ValueError("reduce_blocks_stream over an empty iterator")
+    if len(partials) == 1:
+        out = partials[0]
+    else:
+        stacked = TensorFrame.from_dict(
+            {
+                b: np.stack([np.asarray(p[b]) for p in partials])
+                for b in partials[0]
+            }
+        )
+        r = reduce_blocks(
+            graph, stacked, None, fetch_names=fetch_list, executor=executor
+        )
+        out = r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+    if len(fetch_list) == 1:
+        return out[_base(fetch_list[0])]
+    return out
 
 
 # ---------------------------------------------------------------------------

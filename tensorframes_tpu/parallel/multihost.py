@@ -1,0 +1,88 @@
+"""Multi-host execution: DCN-spanning meshes and per-host data feeding.
+
+Single-host code runs unchanged on a pod: initialize the process group,
+build one global mesh over ALL devices, and feed each host its local
+rows — XLA routes collectives over ICI within a slice and DCN across
+slices (SURVEY.md §2.5's replacement for the reference's Spark substrate;
+multi-host here plays the role of Spark's multi-executor cluster).
+
+    from tensorframes_tpu.parallel import multihost as mh
+    mh.initialize_distributed()            # env-driven on TPU pods
+    mesh = mh.global_data_mesh()
+    df = mh.host_local_frame_to_global(local_frame, mesh)
+    tfs.reduce_blocks(s, df, mesh=mesh)    # all-reduce spans the pod
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..frame import Column, TensorFrame
+from ..schema import ScalarType
+
+__all__ = [
+    "initialize_distributed",
+    "global_data_mesh",
+    "host_local_frame_to_global",
+]
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """`jax.distributed.initialize` wrapper; on TPU pods all arguments are
+    discovered from the environment. Idempotent for single-process runs."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        if num_processes not in (None, 1):
+            raise
+        # single-process (tests / one host): nothing to initialize
+
+
+def global_data_mesh(axes: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh over every device in the job (all hosts)."""
+    devices = np.asarray(jax.devices())
+    if len(axes) == 1:
+        return Mesh(devices, tuple(axes))
+    raise ValueError("use parallel.mesh_2d for multi-axis meshes")
+
+
+def host_local_frame_to_global(
+    frame: TensorFrame, mesh: Mesh
+) -> TensorFrame:
+    """Assemble a global device frame from per-host local rows.
+
+    Each process passes ITS shard of the rows; the returned frame's
+    columns are global jax Arrays sharded over the mesh's ``data`` axis
+    (`jax.make_array_from_process_local_data` — the host-side ring that
+    replaces the reference's Spark partition placement).
+    """
+    new_cols = []
+    for name in frame.columns:
+        c = frame.column(name)
+        if not c.is_dense or c.dtype is ScalarType.string:
+            raise ValueError(
+                f"multi-host frames need dense numeric columns ({name!r})"
+            )
+        spec = P("data", *([None] * c.cell_shape.rank))
+        sharding = NamedSharding(mesh, spec)
+        garr = jax.make_array_from_process_local_data(
+            sharding, np.asarray(c.values)
+        )
+        nc = Column(name, garr, c.dtype)
+        nc.cell_shape = c.cell_shape
+        new_cols.append(nc)
+    return TensorFrame(new_cols)

@@ -37,12 +37,14 @@ class NativeExecutor:
         self.compile_count = 0
 
     def cached(self, kind, graph, fetches, feed_names, make):
-        # Executor-compatible signature; `make` builds a JAX callable —
-        # here we wrap it for per-shape native compilation instead.
-        raise NotImplementedError(
-            "NativeExecutor supports the plain block path (callable_for); "
-            "vmapped/scan execution kinds run via the JAX executor"
-        )
+        # Non-block execution kinds (vmapped rows, scan folds, shard_map)
+        # fall back to the in-process JAX executor: the native host is a
+        # single-program-at-a-time engine by design.
+        if not hasattr(self, "_jax_fallback"):
+            from .executor import Executor
+
+            self._jax_fallback = Executor()
+        return self._jax_fallback.cached(kind, graph, fetches, feed_names, make)
 
     def callable_for(
         self,

@@ -146,3 +146,24 @@ class TestDistributedTrimmedMap:
         np.testing.assert_array_equal(
             out["s"].values, np.arange(16.0).reshape(8, 2).sum(1)
         )
+
+
+class TestMultihost:
+    def test_single_host_global_frame(self, mesh):
+        from tensorframes_tpu.parallel import multihost as mh
+
+        mh.initialize_distributed()  # no-op single process
+        gmesh = mh.global_data_mesh()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        gdf = mh.host_local_frame_to_global(df, gmesh)
+        assert len(gdf["x"].values.sharding.device_set) == 8
+        x_input = tfs.block(gdf, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        assert float(tfs.reduce_blocks(s, gdf, mesh=gmesh)) == 120.0
+
+    def test_ragged_rejected(self, mesh):
+        from tensorframes_tpu.parallel import multihost as mh
+
+        df = tfs.TensorFrame.from_dict({"v": [np.ones(2), np.ones(3)]})
+        with pytest.raises(ValueError, match="dense"):
+            mh.host_local_frame_to_global(df, mh.global_data_mesh())

@@ -378,3 +378,28 @@ class TestReviewRegressions:
         n = ex.compile_count
         tfs.map_rows(g, df, fetch_names=fetches, executor=ex)
         assert ex.compile_count == n
+
+
+class TestReduceBlocksStream:
+    def test_streamed_chunks_match(self):
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.arange(i * 10.0, (i + 1) * 10.0)})
+            for i in range(5)
+        ]
+        x_input = tfs.block(chunks[0], "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        total = tfs.reduce_blocks_stream(s, iter(chunks))
+        assert float(total) == np.arange(50.0).sum()
+
+    def test_single_chunk(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        assert float(tfs.reduce_blocks_stream(s, [df])) == 6.0
+
+    def test_empty_iterator(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        with pytest.raises(ValueError, match="empty"):
+            tfs.reduce_blocks_stream(s, [])

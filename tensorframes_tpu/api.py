@@ -120,7 +120,11 @@ def _as_graph(
             "imported graphs need explicit fetch_names=[...] "
             "(the reference's builder.fetches, PythonInterface.scala:105-108)"
         )
-    return g, list(fetch_names)
+    # Stateful graphs are frozen at import, exactly where the reference
+    # freezes them (`_get_graph` -> `_initialize_variables`, core.py:42-56).
+    from .graph.freeze import freeze_variables
+
+    return freeze_variables(g), list(fetch_names)
 
 
 def _base(name: str) -> str:
@@ -633,6 +637,57 @@ def reduce_blocks(
     return {_base(f): v for f, v in zip(fetch_list, final)}
 
 
+def _prefetch_iter(it, depth: int = 1):
+    """Pull ``it`` on a daemon thread, ``depth`` items ahead. The consumer
+    (device execution) and the producer (chunk synthesis / host IO) then
+    overlap — the streaming analogue of Spark's pipelined partition fetch."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    _END = object()
+    cancelled = threading.Event()
+
+    def _put(msg) -> bool:
+        # Bounded put that gives up when the consumer abandoned the
+        # generator — otherwise the producer thread would block forever
+        # on the full queue, pinning the buffered chunks in memory.
+        while not cancelled.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in it:
+                if not _put(("item", item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
+            _put(("error", e))
+            return
+        _put(("end", _END))
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "error":
+                raise payload
+            if kind == "end":
+                return
+            yield payload
+    finally:
+        cancelled.set()
+        while not q.empty():  # release buffered chunks promptly
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
 def reduce_blocks_stream(
     fetches: Fetches,
     frames,
@@ -642,15 +697,17 @@ def reduce_blocks_stream(
     mesh=None,
 ):
     """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
-    hold at once — the Spark-spill analogue). Each chunk reduces on device
-    while the next stages; chunk partials combine with the same graph.
+    hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
+    background prefetch thread while chunk N reduces on device, so host
+    synthesis/IO overlaps device execution; partials combine with the
+    same graph.
 
     The streaming form is what makes the BASELINE north star (1B-row
     vector reduce_sum) run in bounded host memory.
     """
     graph, fetch_list = _as_graph(fetches, fetch_names)
     partials: List = []
-    for f in frames:
+    for f in _prefetch_iter(frames):
         r = reduce_blocks(
             graph, f, feed_dict, fetch_names=fetch_list,
             executor=executor, mesh=mesh,

@@ -1,12 +1,15 @@
 """Graph layer: IR, GraphDef import/export, analysis, builder DSL."""
 
 from .analysis import GraphSummary, NodeSummary, ShapeHints, analyze_graph
+from .freeze import freeze_variables, has_variables
 from .ir import Graph, GraphNode, parse_edge
 
 __all__ = [
     "Graph",
     "GraphNode",
     "parse_edge",
+    "freeze_variables",
+    "has_variables",
     "GraphSummary",
     "NodeSummary",
     "ShapeHints",

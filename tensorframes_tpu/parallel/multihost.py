@@ -37,19 +37,73 @@ def initialize_distributed(
     process_id: Optional[int] = None,
 ) -> None:
     """`jax.distributed.initialize` wrapper; on TPU pods all arguments are
-    discovered from the environment. Idempotent for single-process runs."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    discovered from the environment. Idempotent, and safe to call in
+    single-process runs (tests, one host).
+
+    NOTE: must run before anything touches a JAX backend (first
+    `jax.devices()` / computation) — so this function itself must not
+    query device or process state before initializing.
+    """
+    # Already-initialized check WITHOUT touching the backend:
+    # jax.process_count() would itself initialize local XLA and make
+    # distributed init impossible afterwards.
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # jax.distributed.initialize already ran in this process
+    except Exception:
+        pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (ValueError, RuntimeError):
-        if num_processes not in (None, 1):
+    except ValueError:
+        # No coordinator configured anywhere (args or environment):
+        # single-process run, nothing to initialize. A genuinely
+        # multi-process call must say so explicitly -> re-raise.
+        if num_processes not in (None, 1) or coordinator_address is not None:
             raise
-        # single-process (tests / one host): nothing to initialize
+    except RuntimeError as e:
+        # Backend already initialized (ordering violation). Swallowing
+        # this on a pod would silently degrade every collective to
+        # per-host partial results — so re-raise whenever the caller
+        # asked for multi-process or a cluster environment is detected;
+        # only a plain single-process late call (tests, local runs) is
+        # benign.
+        explicit = (
+            coordinator_address is not None
+            or num_processes not in (None, 1)
+        )
+        if explicit or _cluster_env_detected():
+            raise RuntimeError(
+                "jax.distributed.initialize failed; call "
+                "initialize_distributed() before any JAX computation "
+                "(it must run before the local backend is created)"
+            ) from e
+
+
+def _cluster_env_detected() -> bool:
+    """True when jax's cluster auto-detection (TPU pod metadata, SLURM,
+    etc.) would configure a MULTI-process job. A single-host TPU VM also
+    advertises cluster metadata (is_env_present is True on a 1-host
+    v5e), so presence alone is not enough — the detected process count
+    must exceed one."""
+    try:
+        from jax._src.clusters import ClusterEnv
+
+        for c in ClusterEnv._cluster_types:
+            try:
+                if not c.is_env_present():
+                    continue
+                return int(c.get_process_count()) > 1
+            except Exception:
+                return True  # present but unreadable: assume a real pod
+        return False
+    except Exception:
+        return False
 
 
 def global_data_mesh(axes: Sequence[str] = ("data",)) -> Mesh:

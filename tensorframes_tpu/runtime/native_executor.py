@@ -31,16 +31,29 @@ class NativeExecutor:
     that owns the same device in-process.
     """
 
-    def __init__(self, plugin_path: Optional[str] = None):
+    def __init__(
+        self, plugin_path: Optional[str] = None, jax_fallback: bool = False
+    ):
         self.host = PjrtHost(plugin_path)
         self._cache: Dict[Tuple, Callable] = {}
         self.compile_count = 0
+        self._allow_jax_fallback = jax_fallback
+        self._jax_fallback = None
 
     def cached(self, kind, graph, fetches, feed_names, make):
         # Non-block execution kinds (vmapped rows, scan folds, shard_map)
-        # fall back to the in-process JAX executor: the native host is a
-        # single-program-at-a-time engine by design.
-        if not hasattr(self, "_jax_fallback"):
+        # need the in-process JAX executor: the native host is a
+        # single-program-at-a-time engine by design. Running a JAX backend
+        # next to a native host that owns the same device is unsafe
+        # (double TPU client), so it is strictly opt-in.
+        if not self._allow_jax_fallback:
+            raise NotImplementedError(
+                f"NativeExecutor runs block-level programs only; {kind!r} "
+                "execution needs the in-process JAX executor. Construct "
+                "NativeExecutor(jax_fallback=True) ONLY if the JAX backend "
+                "does not own the same device as the native host."
+            )
+        if self._jax_fallback is None:
             from .executor import Executor
 
             self._jax_fallback = Executor()

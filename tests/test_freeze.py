@@ -1,0 +1,199 @@
+"""Variable freezing on imported GraphDefs.
+
+The reference ships stateless graphs by freezing TF variables into
+constants before serialization (`core.py:42-56`, exercised by its Python
+test `core_test.py:41-53` "test_map_blocks_0_3" with a `tf.Variable`).
+Here freezing happens at import (`graph/freeze.py`): ref-variable protos
+(TF 1.x wire) and resource-variable protos (modern TF wire) both become
+constant graphs, conformance-checked against a real TF session."""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu.graph.freeze import freeze_variables, has_variables
+from tensorframes_tpu.graph.ir import Graph, GraphNode
+from tensorframes_tpu.ops.lowering import build_callable
+from tensorframes_tpu.proto.graphdef import AttrValue, TensorProto
+from tensorframes_tpu.schema import Shape, ScalarType
+
+
+def _const(name, arr):
+    arr = np.asarray(arr)
+    st = ScalarType.from_np_dtype(arr.dtype)
+    return GraphNode(name, "Const", [], {
+        "dtype": AttrValue.of_type(st),
+        "value": AttrValue.of_tensor(TensorProto.from_numpy(arr)),
+    })
+
+
+def _ref_variable_graph():
+    """TF 1.x-style proto: VariableV2 + Assign + Identity read, the wire
+    pattern of reference-era frozen-model inputs."""
+    f64 = AttrValue.of_type(ScalarType.float64)
+    g = Graph()
+    g.add(_const("v/init", np.array(3.0)))
+    g.add(GraphNode("v", "VariableV2", [], {
+        "dtype": f64, "shape": AttrValue.of_shape(Shape(())),
+    }))
+    g.add(GraphNode("v/Assign", "Assign", ["v", "v/init"], {"T": f64}))
+    g.add(GraphNode("v/read", "Identity", ["v"], {"T": f64}))
+    g.add(GraphNode("init", "NoOp", ["^v/Assign"], {}))
+    g.add(GraphNode("x", "Placeholder", [], {
+        "dtype": f64, "shape": AttrValue.of_shape(Shape((None,))),
+    }))
+    g.add(GraphNode("z", "Add", ["x", "v/read"], {"T": f64}))
+    return g
+
+
+class TestRefVariables:
+    def test_freeze_replaces_variable_with_const(self):
+        g = freeze_variables(_ref_variable_graph())
+        assert not has_variables(g)
+        ops = {n.name: n.op for n in g}
+        assert ops["v"] == "Const"
+        assert "v/Assign" not in ops and "init" not in ops
+        fn = build_callable(g, ["z"], ["x"])
+        (z,) = fn(np.array([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(z), [4.0, 5.0])
+
+    def test_map_blocks_on_stateful_wire_bytes(self):
+        wire = _ref_variable_graph().to_bytes()
+        df = tfs.TensorFrame.from_dict({"x": np.array([1.0, 2.0, 3.0])})
+        out = tfs.map_blocks(wire, df, fetch_names=["z"])
+        np.testing.assert_allclose(
+            np.asarray(out["z"].values), [4.0, 5.0, 6.0]
+        )
+
+    def test_noop_graph_is_same_object(self):
+        g = Graph([_const("c", np.array(1.0))])
+        assert freeze_variables(g) is g
+
+    def test_initializer_assign_preferred_over_compute_assign(self):
+        # a compute-time assign serialized BEFORE the initializer must not
+        # win: <var>/Assign is the startup initializer by TF convention
+        f64 = AttrValue.of_type(ScalarType.float64)
+        g = Graph()
+        g.add(_const("other", np.array(99.0)))
+        g.add(_const("v/init", np.array(3.0)))
+        g.add(GraphNode("v", "VariableV2", [], {"dtype": f64}))
+        g.add(GraphNode("update", "Assign", ["v", "other"], {"T": f64}))
+        g.add(GraphNode("v/Assign", "Assign", ["v", "v/init"], {"T": f64}))
+        g.add(GraphNode("z", "Identity", ["v"], {"T": f64}))
+        out = freeze_variables(g)
+        (z,) = build_callable(out, ["z"], [])()
+        assert float(np.asarray(z)) == 3.0
+
+    def test_control_edge_before_data_inputs(self):
+        # legal GraphDef: Assign inputs may list a control edge first;
+        # the value edge is the second DATA input, not inputs[1]
+        f64 = AttrValue.of_type(ScalarType.float64)
+        g = Graph()
+        g.add(GraphNode("dep", "NoOp", [], {}))
+        g.add(_const("v/init", np.array(7.0)))
+        g.add(GraphNode("v", "VariableV2", [], {"dtype": f64}))
+        g.add(GraphNode(
+            "v/Assign", "Assign", ["^dep", "v", "v/init"], {"T": f64}
+        ))
+        g.add(GraphNode("z", "Identity", ["v"], {"T": f64}))
+        out = freeze_variables(g)
+        (z,) = build_callable(out, ["z"], [])()
+        assert float(np.asarray(z)) == 7.0
+
+    def test_missing_initializer_raises(self):
+        f64 = AttrValue.of_type(ScalarType.float64)
+        g = Graph([GraphNode("v", "VariableV2", [], {"dtype": f64})])
+        with pytest.raises(ValueError, match="no Assign"):
+            freeze_variables(g)
+
+
+# TF-dependent conformance below; the pure-IR tests above must still run
+# on hosts without tensorflow (the package's premise is zero TF at
+# runtime), so gate per-class rather than importorskip'ing the module.
+try:
+    import tensorflow.compat.v1 as tf1
+except ImportError:  # pragma: no cover - TF present in the dev image
+    tf1 = None
+
+
+@pytest.fixture(scope="module")
+def _graph_mode():
+    tf1.disable_eager_execution()
+
+
+@pytest.mark.skipif(tf1 is None, reason="needs real TensorFlow")
+@pytest.mark.usefixtures("_graph_mode")
+class TestResourceVariablesVsRealTF:
+    def _freeze_and_compare(self, build, feeds, fetch):
+        g = tf1.Graph()
+        with g.as_default():
+            build(tf1)
+        with tf1.Session(graph=g) as sess:
+            # per-variable init in creation order: chained initializers
+            # (b reads a) need a initialized before b's init runs
+            with g.as_default():
+                for v in tf1.global_variables():
+                    sess.run(v.initializer)
+            tf_out = sess.run(
+                fetch + ":0", {k + ":0": v for k, v in feeds.items()}
+            )
+        ours_graph = freeze_variables(
+            Graph.from_bytes(g.as_graph_def().SerializeToString())
+        )
+        assert not has_variables(ours_graph)
+        names = sorted(feeds)
+        fn = build_callable(ours_graph, [fetch], names)
+        (ours,) = fn(*[feeds[k] for k in names])
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(tf_out), rtol=1e-6
+        )
+
+    def test_variable_plus_placeholder(self):
+        # mirrors reference core_test.py:41-53: z = x + variable
+        def build(tf):
+            v = tf.Variable(3.0, name="v", dtype=tf.float64)
+            x = tf.placeholder(tf.float64, shape=[None], name="x")
+            tf.add(x, v, name="z")
+
+        self._freeze_and_compare(
+            build, {"x": np.array([1.0, 2.0])}, "z"
+        )
+
+    def test_chained_initializers(self):
+        # b's initializer reads a: freezing must fixpoint across variables
+        def build(tf):
+            a = tf.Variable(np.array([1.0, 2.0]), name="a")
+            b = tf.Variable(a.read_value() * 2.0, name="b")
+            x = tf.placeholder(tf.float64, shape=[2], name="x")
+            tf.identity(x + a + b, name="z")
+
+        self._freeze_and_compare(build, {"x": np.array([0.5, 0.5])}, "z")
+
+    def test_matrix_variable_matmul(self):
+        def build(tf):
+            w = tf.get_variable(
+                "w", shape=[3, 2], dtype=tf.float64,
+                initializer=tf.ones_initializer(), use_resource=True,
+            )
+            x = tf.placeholder(tf.float64, shape=[None, 3], name="x")
+            tf.matmul(x, w, name="z")
+
+        self._freeze_and_compare(
+            build, {"x": np.arange(6, dtype=np.float64).reshape(2, 3)}, "z"
+        )
+
+    def test_end_to_end_map_blocks(self):
+        g = tf1.Graph()
+        with g.as_default():
+            v = tf1.Variable(np.array([10.0, 20.0]), name="v")
+            x = tf1.placeholder(tf1.float64, shape=[None, 2], name="x")
+            tf1.add(x, v, name="z")
+        wire = g.as_graph_def().SerializeToString()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(8, dtype=np.float64).reshape(4, 2)}
+        )
+        out = tfs.map_blocks(wire, df, fetch_names=["z"])
+        np.testing.assert_allclose(
+            np.asarray(out["z"].values),
+            np.arange(8, dtype=np.float64).reshape(4, 2) + [10.0, 20.0],
+        )

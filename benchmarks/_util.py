@@ -1,0 +1,31 @@
+"""Shared helpers for the benchmark suite.
+
+The reference ships perf harnesses but keeps them all `ignore`d and never
+records a number (`perf/ConvertPerformanceSuite.scala`,
+`perf/ConvertBackPerformanceSuite.scala`, `perf/PerformanceSuite.scala` —
+see SURVEY.md §6). This suite re-creates each of them as a real, runnable
+benchmark that prints one JSON line per metric, the same wire format as
+the repo-root `bench.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def scaled(env: str, default: int) -> int:
+    """Problem size, overridable via env (smaller on CPU smoke runs)."""
+    return int(os.environ.get(env, default))
+
+
+def emit(metric: str, value: float, unit: str, baseline: Optional[float] = None):
+    line = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": (value / baseline) if baseline else None,
+    }
+    print(json.dumps(line))
+    return line

@@ -1,0 +1,63 @@
+"""Row⇄Tensor conversion benchmarks.
+
+Real versions of the reference's `ignore`d harnesses:
+
+- `ConvertPerformanceSuite.scala:23-44`: 10M rows of one scalar int cell,
+  Row → Tensor. Here: python row dicts → `TensorFrame` dense column →
+  device buffer (the full ingest path the verbs feed from).
+- `ConvertPerformanceSuite.scala:46-68`: 1 row × one 10M-int vector cell.
+- `ConvertBackPerformanceSuite.scala:24-50`: Tensor → Row for the same
+  10M cells (here: device column → host rows via `collect`).
+
+Sizes are env-tunable: CONVERT_CELLS (default 10_000_000).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def main():
+    import jax
+
+    import tensorframes_tpu as tfs
+
+    n = scaled("CONVERT_CELLS", 10_000_000)
+
+    # --- Row -> Tensor, n scalar cells --------------------------------
+    rows = [{"x": i} for i in range(n)]
+    t0 = time.perf_counter()
+    df = tfs.TensorFrame.from_rows(rows)
+    dev = df.to_device()
+    jax.block_until_ready(dev["x"].values)
+    dt = time.perf_counter() - t0
+    emit("convert row->tensor scalar cells", n / dt, "cells/s")
+
+    # --- Row -> Tensor, 1 row x n-int vector cell ---------------------
+    vec = np.arange(n, dtype=np.int64)
+    t0 = time.perf_counter()
+    df2 = tfs.TensorFrame.from_rows([{"x": vec}])
+    dev2 = df2.to_device()
+    jax.block_until_ready(dev2["x"].values)
+    dt = time.perf_counter() - t0
+    emit("convert row->tensor one vector cell", n / dt, "cells/s")
+
+    # --- Tensor -> Row (convertBack) ----------------------------------
+    out = tfs.map_blocks(lambda x: {"y": x + x}, dev)
+    jax.block_until_ready(out["y"].values)
+    t0 = time.perf_counter()
+    collected = out.collect()
+    dt = time.perf_counter() - t0
+    assert int(collected[3]["y"]) == 6
+    emit("convertBack tensor->row scalar cells", n / dt, "cells/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Distributed k-means benchmark: framework vs host-numpy baseline.
+
+Real version of the reference's flagship demo timing
+(`tensorframes_snippets/kmeans_demo.py`: 100k rows x 100 features, k=10,
+which prints `mllib:` vs `tf+spark:` wall times but records nothing).
+MLlib isn't in this stack; the stand-in baseline is a straight NumPy
+Lloyd loop on the host — the framework must beat it for the TPU path to
+be worth anything.
+
+Sizes: KMEANS_ROWS (100_000), KMEANS_DIM (100), KMEANS_K (10),
+KMEANS_ITERS (10).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def numpy_lloyd(pts, k, iters, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = pts[rng.choice(len(pts), k, replace=False)]
+    for _ in range(iters):
+        d = (
+            (pts * pts).sum(1)[:, None]
+            - 2.0 * pts @ centers.T
+            + (centers * centers).sum(1)
+        )
+        a = d.argmin(1)
+        sums = np.zeros_like(centers)
+        counts = np.zeros(k)
+        np.add.at(sums, a, pts)
+        np.add.at(counts, a, 1)
+        nz = counts > 0
+        centers[nz] = sums[nz] / counts[nz, None]
+    return centers
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.models import kmeans as tfs_kmeans
+
+    n = scaled("KMEANS_ROWS", 100_000)
+    dim = scaled("KMEANS_DIM", 100)
+    k = scaled("KMEANS_K", 10)
+    iters = scaled("KMEANS_ITERS", 10)
+
+    rng = np.random.RandomState(0)
+    pts = rng.rand(n, dim).astype(np.float32)
+
+    df = tfs.TensorFrame.from_dict({"features": pts}, num_blocks=4).to_device()
+    # warm-up (compile)
+    tfs_kmeans(df, "features", k, num_iters=1, seed=0)
+
+    t0 = time.perf_counter()
+    centers, counts = tfs_kmeans(df, "features", k, num_iters=iters, seed=0)
+    tf_dt = time.perf_counter() - t0
+    assert counts.sum() == n
+
+    t0 = time.perf_counter()
+    numpy_lloyd(pts, k, iters)
+    np_dt = time.perf_counter() - t0
+
+    emit(
+        f"kmeans {n}x{dim} k={k} x{iters} iters",
+        n * iters / tf_dt,
+        "rows*iters/s",
+        baseline=n * iters / np_dt,
+    )
+    print(
+        f"# numpy-host: {np_dt:.3f}s  framework: {tf_dt:.3f}s "
+        f"(speedup {np_dt / tf_dt:.2f}x)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""`map_blocks` x+x then sum over 20M longs, x10 iterations.
+
+Real version of the reference's `ignore`d `PerformanceSuite.scala:15-27`
+("Simple performance test": df of 20M longs, `mapBlocks(x+x)` then an SQL
+sum, repeated 10 times with per-iteration timings). Here the map is a
+compiled XLA call per block and the sum is `reduce_blocks` — the full
+verb pipeline, timed end to end per iteration.
+
+Sizes: MAPSUM_ROWS (default 20_000_000), MAPSUM_ITERS (default 10).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import dsl
+
+    n = scaled("MAPSUM_ROWS", 20_000_000)
+    iters = scaled("MAPSUM_ITERS", 10)
+
+    df = tfs.TensorFrame.from_dict(
+        {"x": np.arange(n, dtype=np.int64)}
+    ).to_device()
+
+    x = tfs.block(df, "x")
+    z = (x + x).named("z")
+
+    def once():
+        mapped = tfs.map_blocks(z, df)
+        zc = tfs.block(mapped, "z", tf_name="z_input")
+        s = tfs.dsl.reduce_sum(zc, axes=[0]).named("z")
+        return tfs.reduce_blocks(s, mapped)
+
+    expected = 2 * (n - 1) * n // 2
+    assert int(once()) == expected  # warm-up + correctness
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        total = once()
+        times.append(time.perf_counter() - t0)
+    assert int(total) == expected
+    best = min(times)
+    emit("map_blocks x+x + reduce_sum (20M longs)", n / best, "rows/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Run the full benchmark suite; one JSON line per metric on stdout.
+
+Mirrors SURVEY.md §6's table: every harness the reference left `ignore`d
+is a live benchmark here. `BENCH_SMOKE=1` shrinks every size for a quick
+CI pass.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+SMOKE_SIZES = {
+    "CONVERT_CELLS": "200000",
+    "MAPSUM_ROWS": "200000",
+    "MAPSUM_ITERS": "3",
+    "KMEANS_ROWS": "5000",
+    "KMEANS_DIM": "16",
+    "KMEANS_ITERS": "3",
+}
+
+
+def main():
+    if os.environ.get("BENCH_SMOKE"):
+        for k, v in SMOKE_SIZES.items():
+            os.environ.setdefault(k, v)
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.dirname(here))
+    for mod in ("convert_bench", "map_sum_bench", "kmeans_bench"):
+        runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,11 @@ reduction is one XLA call per chunk. Run: ``python
 examples/billion_row_reduce.py --rows 1000000000``.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import json
 import time

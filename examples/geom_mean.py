@@ -5,6 +5,11 @@ value through log (or reciprocal), aggregate per-key sums + counts with
 the x -> x_input convention, finish on the host.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import tensorframes_tpu as tfs

@@ -5,6 +5,11 @@ tokens of activations while K/V blocks rotate over ICI — context length
 scales with chip count.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 import numpy as np

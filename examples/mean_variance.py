@@ -6,6 +6,11 @@ collectives instead of a driver funnel. Row count scales via
 ``--rows`` (config #4 uses 100M).
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 import time
 

@@ -10,6 +10,11 @@ Here: same verbs, graphs built with the builder DSL (or imported
 GraphDefs, or plain Python functions), executed by XLA.
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import tensorframes_tpu as tfs

@@ -30,7 +30,9 @@ from .api import (
     analyze,
     append_shape,
     block,
+    block_to_row,
     explain,
+    explain_detailed,
     group_by,
     map_blocks,
     map_rows,
@@ -59,7 +61,9 @@ __all__ = [
     "analyze",
     "append_shape",
     "block",
+    "block_to_row",
     "explain",
+    "explain_detailed",
     "group_by",
     "map_blocks",
     "map_rows",

@@ -62,6 +62,8 @@ __all__ = [
     "group_by",
     "GroupedFrame",
     "explain",
+    "explain_detailed",
+    "block_to_row",
 ]
 
 Fetches = Union[dsl.Tensor, Sequence[dsl.Tensor], Graph, bytes, str, Callable]
@@ -154,16 +156,55 @@ def _default_column(ph_name: str, frame: TensorFrame) -> str:
     return ph_name
 
 
+def _check_bindings(
+    summary: GraphSummary, bindings: Dict[str, "np.ndarray"]
+) -> None:
+    """Validate per-call bound arrays against their placeholders.
+
+    Bindings are the TPU-native answer to the reference's pattern of
+    re-embedding updated values as graph constants each iteration (e.g.
+    `kmeans_demo.py` rebuilds the graph with new centers every Lloyd step,
+    which under XLA would force a recompile per step): a bound array is a
+    *jit argument*, so the compiled executable is reused across calls as
+    long as the shape is stable."""
+    from .schema import ScalarType
+
+    for name, arr in bindings.items():
+        if name not in summary.inputs:
+            raise ValueError(
+                f"binding {name!r} does not match any placeholder "
+                f"(placeholders: {sorted(summary.inputs)})"
+            )
+        ph = summary.inputs[name]
+        st = ScalarType.from_np_dtype(np.dtype(arr.dtype))
+        if st is not ph.dtype:
+            raise ValueError(
+                f"binding {name!r} has dtype {st.name} but placeholder wants "
+                f"{ph.dtype.name} (TF graphs do not promote dtypes)"
+            )
+        if not Shape(arr.shape).check_more_precise_than(ph.shape):
+            raise ValueError(
+                f"binding {name!r} with shape {tuple(arr.shape)} is not "
+                f"compatible with placeholder shape {ph.shape}"
+            )
+
+
 def _match_columns(
     summary: GraphSummary,
     frame: TensorFrame,
     feed_dict: Optional[Dict[str, str]],
     block_level: bool,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> Dict[str, str]:
-    """Map placeholder name -> column name; validate dtype + shape precision."""
+    """Map placeholder name -> column name; validate dtype + shape precision.
+
+    Placeholders named in ``bindings`` are fed the bound array per call
+    instead of a column and are excluded from the mapping."""
     feed_dict = feed_dict or {}
     mapping: Dict[str, str] = {}
     for ph_name, ph in summary.inputs.items():
+        if bindings and ph_name in bindings:
+            continue
         col_name = feed_dict.get(ph_name, _default_column(ph_name, frame))
         if col_name not in frame.info:
             raise ValueError(
@@ -205,14 +246,25 @@ def _ph_overrides(
     frame: TensorFrame,
     feed_dict: Optional[Dict[str, str]],
     block_level: bool,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> Dict[str, Shape]:
     """Column shapes are usually *more* precise than placeholder attrs
     (e.g. imported graphs carry [?,?]); inject them for tighter analysis,
     mirroring how `block()` stamps column shapes onto placeholders
     (`DslImpl.scala:90-107`)."""
     feed_dict = feed_dict or {}
+    bindings = bindings or {}
     overrides: Dict[str, Shape] = {}
     for ph in summary_graph.placeholders():
+        if ph.name in bindings:
+            shape = Shape(np.asarray(bindings[ph.name]).shape)
+            attr = ph.shape_attr
+            # Only overriding when compatible (same guard as the column
+            # path below) keeps the declared placeholder shape visible to
+            # _check_bindings for incompatible bindings.
+            if attr is None or shape.check_more_precise_than(attr):
+                overrides[ph.name] = shape
+            continue
         col_name = feed_dict.get(ph.name, _default_column(ph.name, frame))
         if col_name in frame.info:
             info = frame.info[col_name]
@@ -262,13 +314,17 @@ def _output_frame(
 # ---------------------------------------------------------------------------
 
 
-def _fn_feed_columns(fn: Callable, frame: TensorFrame) -> List[str]:
+def _fn_feed_columns(
+    fn: Callable, frame: TensorFrame, bound: Optional[set] = None
+) -> List[str]:
     params = [
         p.name
         for p in inspect.signature(fn).parameters.values()
         if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
     ]
-    missing = [p for p in params if p not in frame.info]
+    missing = [
+        p for p in params if p not in frame.info and p not in (bound or ())
+    ]
     if missing:
         raise ValueError(
             f"function front-end: parameters {missing} have no matching "
@@ -300,27 +356,37 @@ def map_blocks(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> TensorFrame:
     """Apply a graph to each block; one jitted XLA call per block.
 
     `DebugRowOps.mapBlocks` (`DebugRowOps.scala:290-400`). With
     ``trim=True`` the row count may change and input columns are dropped
     (`Operations.scala:59-76`). With ``mesh=`` the blocks shard across the
-    device mesh (see `parallel.verbs`).
+    device mesh (see `parallel.verbs`). ``bindings`` feeds named
+    placeholders a per-call array instead of a column — updates between
+    calls do NOT recompile (see `_check_bindings`).
     """
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
         return _pverbs.map_blocks(
-            fetches, frame, mesh, feed_dict, trim, fetch_names, executor
+            fetches, frame, mesh, feed_dict, trim, fetch_names, executor,
+            bindings=bindings,
         )
     ex = executor or default_executor()
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
-        return _map_blocks_fn(fetches, frame, trim, ex)
+        return _map_blocks_fn(fetches, frame, trim, ex, bindings=bindings)
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     graph, fetch_list = _as_graph(fetches, fetch_names)
-    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    overrides = _ph_overrides(
+        graph, frame, feed_dict, block_level=True, bindings=bindings
+    )
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
-    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _check_bindings(summary, bindings)
+    mapping = _match_columns(
+        summary, frame, feed_dict, block_level=True, bindings=bindings
+    )
     _require_dense(frame, list(mapping.values()), "map_blocks")
 
     feed_names = sorted(summary.inputs)
@@ -335,8 +401,14 @@ def map_blocks(
             continue  # empty block: contributes nothing (the reference's
             # empty-partition TODO, `DebugRowOps.scala:386-387`)
         feeds = [
-            v if (lo == 0 and hi == frame.nrows) else v[lo:hi]
-            for v in (frame.column(mapping[n]).values for n in feed_names)
+            bindings[n]
+            if n in bindings
+            else (
+                frame.column(mapping[n]).values
+                if (lo == 0 and hi == frame.nrows)
+                else frame.column(mapping[n]).values[lo:hi]
+            )
+            for n in feed_names
         ]
         from . import config as _config
         from .runtime.retry import run_with_retries
@@ -385,10 +457,21 @@ def map_blocks(
 
 
 def _map_blocks_fn(
-    fn: Callable, frame: TensorFrame, trim: bool, ex: Executor
+    fn: Callable,
+    frame: TensorFrame,
+    trim: bool,
+    ex: Executor,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> TensorFrame:
-    params = _fn_feed_columns(fn, frame)
-    _require_dense(frame, params, "map_blocks")
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
+    params = _fn_feed_columns(fn, frame, bound=set(bindings))
+    unknown = sorted(set(bindings) - set(params))
+    if unknown:
+        raise ValueError(
+            f"bindings {unknown} do not match any function parameter "
+            f"(parameters: {params})"
+        )
+    _require_dense(frame, [p for p in params if p not in bindings], "map_blocks")
     jfn = jax.jit(lambda *args: _fn_outputs_to_dict(fn(*args), "map_blocks"))
     acc: Dict[str, List[np.ndarray]] = {}
     out_sizes: List[int] = []
@@ -397,7 +480,12 @@ def _map_blocks_fn(
         if lo == hi:
             out_sizes.append(0)
             continue
-        outs = jfn(*[frame.column(p).values[lo:hi] for p in params])
+        outs = jfn(
+            *[
+                bindings[p] if p in bindings else frame.column(p).values[lo:hi]
+                for p in params
+            ]
+        )
         bsize = None
         for name, o in outs.items():
             if o.ndim == 0:
@@ -996,6 +1084,40 @@ def append_shape(frame: TensorFrame, col: str, shape) -> TensorFrame:
 def explain(frame: TensorFrame) -> str:
     """`OperationsInterface.explain` (`DebugRowOps.scala:535-552`)."""
     return frame.info.explain()
+
+
+def explain_detailed(frame: TensorFrame):
+    """Structured per-column tensor metadata, the analogue of
+    `ExperimentalOperations.explainDetailed` (`ExperimentalOperations.scala:27`):
+    returns the `FrameInfo` itself rather than a rendered string."""
+    return frame.info
+
+
+def block_to_row(frame: TensorFrame) -> TensorFrame:
+    """Convert each block to a single row, augmenting every column's rank
+    by one (lead dim = block row count).
+
+    The reference declares this operation but never implements it
+    (`ExperimentalOperations.convertBlockToRow` is literally `???`,
+    `ExperimentalOperations.scala:25`); here it is real. Blocks of unequal
+    size produce a ragged column (lead dim Unknown), exactly like the
+    reference's variable-length rows."""
+    per_col_cells: Dict[str, list] = {name: [] for name in frame.columns}
+    for blk in frame.blocks():
+        for name in frame.columns:
+            col = blk[name]
+            if col.is_dense:
+                per_col_cells[name].append(np.asarray(col.values))
+            else:
+                # ragged rows inside a block cannot stack into one cell
+                raise ValueError(
+                    f"block_to_row: column {name!r} is ragged; analyze/pad first"
+                )
+    cols = [
+        Column(name, per_col_cells[name], frame[name].dtype)
+        for name in frame.columns
+    ]
+    return TensorFrame(cols)
 
 
 def block(frame: TensorFrame, col_name: str, tf_name: Optional[str] = None):

@@ -21,18 +21,21 @@ from ..schema import ScalarType
 __all__ = ["kmeans"]
 
 
-def _assignment_graph(centers: np.ndarray, feature_col: str):
+def _assignment_graph(k: int, dim: int, np_dtype, feature_col: str):
     """Trimmed map_blocks graph: block of points -> (k, dim+1) partials.
 
     Emits one row per centroid: [sum of assigned points, count] — the
-    `unsorted_segment_sum` trick from the reference demo.
+    `unsorted_segment_sum` trick from the reference demo. Centers enter as
+    a *bound placeholder*, not a constant: the reference demo rebuilds the
+    graph with new centers each Lloyd iteration (`kmeans_demo.py`), which
+    under XLA would recompile every step; a binding is a jit argument, so
+    the executable compiles once and is reused for all iterations.
     """
-    k, dim = centers.shape
-    st = ScalarType.from_np_dtype(centers.dtype)
+    st = ScalarType.from_np_dtype(np.dtype(np_dtype))
     from ..schema import Shape
 
     pts = dsl.placeholder(st, Shape((None, dim)), name=feature_col)
-    c = dsl.constant(centers, name="centers")  # (k, dim)
+    c = dsl.placeholder(st, Shape((k, dim)), name="centers")
     # squared distances via ||p||^2 - 2 p.c + ||c||^2 ; argmin over k
     p2 = dsl.reduce_sum(dsl.square(pts), axes=[1], keep_dims=True)  # (n,1)
     pc = dsl.matmul(pts, c, transpose_b=True)  # (n,k)
@@ -68,11 +71,14 @@ def kmeans(
     centers = data[rng.choice(n, size=k, replace=False)].copy()
     counts = np.zeros(k)
 
+    partial = _assignment_graph(k, dim, data.dtype, feature_col)
     for _ in range(num_iters):
-        partial = _assignment_graph(centers, feature_col)
         # trimmed map: each block contributes k partial rows; with a mesh,
         # blocks shard across devices and partials combine on host (tiny).
-        part_frame = api.map_blocks(partial, frame, trim=True, mesh=mesh)
+        part_frame = api.map_blocks(
+            partial, frame, trim=True, mesh=mesh,
+            bindings={"centers": centers},
+        )
         parts = np.asarray(part_frame["partial"].values).reshape(-1, k, dim + 1)
         totals = parts.sum(axis=0)  # (k, dim+1)
         counts = totals[:, -1]

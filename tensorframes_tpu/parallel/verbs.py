@@ -77,22 +77,31 @@ def map_blocks(
     trim: bool = False,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> TensorFrame:
     """Distributed map_blocks: one block per device.
 
     Trimmed maps work too: the same graph on same-shaped shards produces
     the same output row count on every device (XLA static shapes), so the
     shard outputs concatenate cleanly — each device's rows form one block.
+    Bound placeholders (``bindings``) are replicated to every device.
     """
     ex = executor or default_executor()
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
-    overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
+    overrides = _api._ph_overrides(
+        graph, frame, feed_dict, block_level=True, bindings=bindings
+    )
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
-    mapping = _api._match_columns(summary, frame, feed_dict, block_level=True)
+    _api._check_bindings(summary, bindings)
+    mapping = _api._match_columns(
+        summary, frame, feed_dict, block_level=True, bindings=bindings
+    )
     _api._require_dense(frame, list(mapping.values()), "map_blocks")
 
     feed_names = sorted(summary.inputs)
-    cols_used = [mapping[n] for n in feed_names]
+    col_feeds = [n for n in feed_names if n not in bindings]
+    cols_used = [mapping[n] for n in col_feeds]
     ndev = mesh.devices.size
     main, tail, s = _split(frame, cols_used, ndev)
 
@@ -100,13 +109,27 @@ def map_blocks(
     acc: Dict[str, List] = {_base(f): [] for f in fetch_list}
     block_sizes: List[int] = []
 
+    def _feeds(source: Dict[str, "np.ndarray"]) -> List:
+        return [
+            bindings[n] if n in bindings else source[mapping[n]]
+            for n in feed_names
+        ]
+
     if s > 0:
         in_specs = tuple(
-            P("data", *([None] * (main[c].ndim - 1))) for c in cols_used
+            P(*([None] * bindings[n].ndim))
+            if n in bindings
+            else P("data", *([None] * (main[mapping[n]].ndim - 1)))
+            for n in feed_names
         )
         out_specs = P("data")
+        # in_specs depend on WHICH placeholders are bound (replicated) and
+        # on feed ranks — both must be part of the cache key, or a later
+        # call with a different binding set would reuse a shard_map whose
+        # specs shard/replicate the wrong arguments.
+        spec_sig = ";".join(str(s) for s in in_specs)
         sharded = ex.cached(
-            f"shmap-{ndev}",
+            f"shmap-{ndev}-[{spec_sig}]",
             graph,
             fetch_list,
             feed_names,
@@ -116,7 +139,7 @@ def map_blocks(
                 )
             ),
         )
-        outs = sharded(*[main[c] for c in cols_used])
+        outs = sharded(*_feeds(main))
         shard_out = None
         for f, o in zip(fetch_list, outs):
             if not trim and o.shape[0] != s * ndev:
@@ -135,7 +158,7 @@ def map_blocks(
         block_sizes += [shard_out if trim else s] * ndev
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
-        outs = tfn(*[tail[c] for c in cols_used])
+        outs = tfn(*_feeds(tail))
         tail_out = None
         for f, o in zip(fetch_list, outs):
             if trim:

@@ -160,3 +160,44 @@ class TestPadRagged:
     def test_dense_noop(self):
         tf = TensorFrame.from_dict({"v": np.ones((3, 2))})
         assert tf.pad_ragged("v") is tf
+
+
+class TestBlockToRow:
+    def test_equal_blocks_densify(self):
+        import tensorframes_tpu as tfs
+
+        tf = TensorFrame.from_dict(
+            {"x": np.arange(6.0), "v": np.arange(12.0).reshape(6, 2)},
+            num_blocks=2,
+        )
+        out = tfs.block_to_row(tf)
+        assert len(out["x"]) == 2
+        assert out["x"].values.shape == (2, 3)
+        assert out["v"].values.shape == (2, 3, 2)
+        np.testing.assert_array_equal(out["x"].values[1], [3.0, 4.0, 5.0])
+
+    def test_unequal_blocks_ragged(self):
+        import tensorframes_tpu as tfs
+
+        tf = TensorFrame.from_dict({"x": np.arange(5.0)}, num_blocks=2)
+        out = tfs.block_to_row(tf)
+        assert not out["x"].is_dense
+        sizes = sorted(len(c) for c in out["x"].ragged)
+        assert sizes == [2, 3]
+
+    def test_ragged_input_rejected(self):
+        import tensorframes_tpu as tfs
+
+        tf = TensorFrame.from_dict({"v": [np.arange(2.0), np.arange(3.0)]})
+        with pytest.raises(ValueError, match="ragged"):
+            tfs.block_to_row(tf)
+
+
+class TestExplainDetailed:
+    def test_returns_frame_info(self):
+        import tensorframes_tpu as tfs
+
+        tf = TensorFrame.from_dict({"x": np.arange(3.0)})
+        info = tfs.explain_detailed(tf)
+        assert info.names == ["x"]
+        assert info["x"].dtype is ScalarType.float64

@@ -167,3 +167,71 @@ class TestMultihost:
         df = tfs.TensorFrame.from_dict({"v": [np.ones(2), np.ones(3)]})
         with pytest.raises(ValueError, match="dense"):
             mh.host_local_frame_to_global(df, mh.global_data_mesh())
+
+
+class TestDistributedBindings:
+    def test_binding_replicated_over_mesh(self, mesh):
+        # kmeans pattern: points shard over the data axis, centers (the
+        # bound placeholder) replicate to every device.
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        x = tfs.block(df, "x")
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        out = tfs.map_blocks(
+            (x * w).named("z"), df, mesh=mesh, bindings={"w": np.float64(2.0)}
+        )
+        np.testing.assert_array_equal(out["z"].values, 2 * np.arange(16.0))
+
+    def test_binding_with_tail(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(19.0)})
+        x = tfs.block(df, "x")
+        c = dsl.placeholder(ScalarType.float64, Shape(()), name="c")
+        out = tfs.map_blocks(
+            (x + c).named("z"), df, mesh=mesh, bindings={"c": np.float64(5.0)}
+        )
+        np.testing.assert_array_equal(out["z"].values, np.arange(19.0) + 5.0)
+
+    def test_kmeans_over_mesh_compiles_once(self, mesh):
+        from tensorframes_tpu.models import kmeans
+
+        rng = np.random.RandomState(0)
+        pts = np.concatenate(
+            [rng.randn(40, 3) + 5.0, rng.randn(40, 3) - 5.0]
+        ).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"features": pts})
+        centers, counts = kmeans(df, "features", 2, num_iters=5, mesh=mesh)
+        assert counts.sum() == 80
+        assert sorted(counts) == [40, 40]
+
+    def test_binding_set_changes_do_not_reuse_stale_specs(self, mesh):
+        # SAME graph fingerprint both calls; placeholder bound (replicated)
+        # in call 1 but column-fed (sharded) in call 2. A cache key that
+        # ignores the binding set would reuse call 1's shard_map, whose
+        # in_specs replicate w — call 2 would then see the FULL w column on
+        # every device (sum=16) instead of its 2-row shard (sum=2).
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(16.0), "w": np.ones(16)}
+        )
+        x = tfs.block(df, "x")
+        w = dsl.placeholder(ScalarType.float64, Shape((None,)), name="w")
+        z = (x * dsl.reduce_sum(w, axes=[0])).named("z")
+        out1 = tfs.map_blocks(z, df, mesh=mesh, bindings={"w": np.ones(8)})
+        np.testing.assert_array_equal(out1["z"].values, 8 * np.arange(16.0))
+        out2 = tfs.map_blocks(z, df, mesh=mesh)
+        # block = shard: each device's local sum over its 2-row w shard
+        np.testing.assert_array_equal(out2["z"].values, 2 * np.arange(16.0))
+
+    def test_kmeans_iterations_do_not_recompile(self, mesh):
+        from tensorframes_tpu.models import kmeans
+        from tensorframes_tpu.runtime.executor import default_executor
+
+        rng = np.random.RandomState(0)
+        pts = rng.randn(64, 3).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"features": pts})
+        kmeans(df, "features", 2, num_iters=1, mesh=mesh)  # compile
+        ex = default_executor()
+        before = ex.compile_count
+        kmeans(df, "features", 2, num_iters=6, mesh=mesh)
+        assert ex.compile_count == before, (
+            "Lloyd iterations with bound centers must reuse the compiled "
+            "executable"
+        )

@@ -403,3 +403,68 @@ class TestReduceBlocksStream:
         s = dsl.reduce_sum(x_input, axes=[0]).named("x")
         with pytest.raises(ValueError, match="empty"):
             tfs.reduce_blocks_stream(s, [])
+
+
+class TestBindings:
+    """Per-call bound placeholders: jit arguments, not baked constants."""
+
+    def test_dsl_graph_binding(self):
+        df = frame_of(x=np.array([1.0, 2.0, 3.0]))
+        x = tfs.block(df, "x")
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        z = (x * w).named("z")
+        out = tfs.map_blocks(z, df, bindings={"w": np.float64(10.0)})
+        np.testing.assert_array_equal(out["z"].values, [10.0, 20.0, 30.0])
+        # updated binding, same graph object: no rebuild needed
+        out2 = tfs.map_blocks(z, df, bindings={"w": np.float64(-1.0)})
+        np.testing.assert_array_equal(out2["z"].values, [-1.0, -2.0, -3.0])
+
+    def test_fn_frontend_binding(self):
+        df = frame_of(x=np.array([1.0, 2.0]))
+        out = tfs.map_blocks(
+            lambda x, scale: {"z": x * scale},
+            df,
+            bindings={"scale": np.float64(3.0)},
+        )
+        np.testing.assert_array_equal(out["z"].values, [3.0, 6.0])
+
+    def test_vector_binding_multi_block(self):
+        df = tfs.TensorFrame.from_dict(
+            {"v": np.arange(8.0).reshape(4, 2)}, num_blocks=2
+        )
+        vv = tfs.block(df, "v")
+        c = dsl.placeholder(ScalarType.float64, Shape((2,)), name="offset")
+        z = (vv + c).named("z")
+        out = tfs.map_blocks(z, df, bindings={"offset": np.array([10.0, 20.0])})
+        np.testing.assert_array_equal(out["z"].values[0], [10.0, 21.0])
+        np.testing.assert_array_equal(out["z"].values[3], [16.0, 27.0])
+
+    def test_unknown_binding_rejected(self):
+        df = frame_of(x=np.array([1.0]))
+        x = tfs.block(df, "x")
+        z = (x + 1.0).named("z")
+        with pytest.raises(ValueError, match="does not match any placeholder"):
+            tfs.map_blocks(z, df, bindings={"nope": np.float64(1.0)})
+
+    def test_binding_dtype_mismatch(self):
+        df = frame_of(x=np.array([1.0]))
+        x = tfs.block(df, "x")
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        z = (x * w).named("z")
+        with pytest.raises(ValueError, match="dtype"):
+            tfs.map_blocks(z, df, bindings={"w": np.int32(2)})
+
+    def test_binding_shape_incompatible(self):
+        df = frame_of(x=np.arange(4.0).reshape(2, 2))
+        x = tfs.block(df, "x")
+        c = dsl.placeholder(ScalarType.float64, Shape((2,)), name="c")
+        z = (x + c).named("z")
+        with pytest.raises(ValueError, match="not compatible"):
+            tfs.map_blocks(z, df, bindings={"c": np.zeros((3,))})
+
+    def test_fn_frontend_unknown_binding_rejected(self):
+        df = frame_of(x=np.array([1.0]))
+        with pytest.raises(ValueError, match="do not match any function"):
+            tfs.map_blocks(
+                lambda x: {"z": x}, df, bindings={"Scale": np.float64(1.0)}
+            )

@@ -2,8 +2,10 @@
 
 from .checkpoint import load_frame, load_params, save_frame, save_params
 from .profiling import annotate, record, reset_stats, stats, trace
+from .virtual_mesh import force_virtual_cpu_devices
 
 __all__ = [
+    "force_virtual_cpu_devices",
     "load_frame",
     "load_params",
     "save_frame",

@@ -12,14 +12,14 @@ JAX_PLATFORMS env var is not enough — we update the config directly, which
 wins as long as no backend has been initialized yet.
 """
 
-import os
-
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
+# Force the CPU platform BEFORE importing the project package: the
+# package __init__ pulls in jax, and if any module ever did
+# backend-initializing work at import time it must land on CPU, never on
+# the sitecustomize-registered hardware platform.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+from tensorframes_tpu.utils.virtual_mesh import force_virtual_cpu_devices
+
+force_virtual_cpu_devices(8)

@@ -85,6 +85,15 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool, scale: float):
     m0 = jnp.full((sq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((sq,), jnp.float32)
     o0 = jnp.zeros((sq, d), jnp.float32)
+    # under VMA tracking the loop carry must enter with the same
+    # device-variance it leaves with (it picks up axis variance from the
+    # rotating K/V, the axis_index masks, and q itself)
+    vma = frozenset({axis_name}).union(
+        *(getattr(x.aval, "vma", frozenset()) for x in (q, k, v))
+    )
+    m0, l0, o0 = (
+        lax.pcast(x, tuple(sorted(vma)), to="varying") for x in (m0, l0, o0)
+    )
     _, _, m, l, o = lax.fori_loop(0, ndev, body, (k, v, m0, l0, o0))
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't happen)
     return (o / l[:, None]).astype(q.dtype)

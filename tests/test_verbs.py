@@ -468,3 +468,62 @@ class TestBindings:
             tfs.map_blocks(
                 lambda x: {"z": x}, df, bindings={"Scale": np.float64(1.0)}
             )
+
+
+class TestEmptyBlocks:
+    """Empty blocks inside a frame contribute nothing and never reach the
+    compiled graph — the reference flags this as an untested TODO
+    (`DebugRowOps.scala:386-387`, `:496`, `:520`); here it is pinned."""
+
+    def _frame(self):
+        from tensorframes_tpu.frame import Column, TensorFrame
+
+        # blocks: [], [0,1,2], [], [3,4], []
+        return TensorFrame(
+            [Column("x", np.arange(5.0))], offsets=[0, 0, 3, 3, 5, 5]
+        )
+
+    def test_map_blocks_skips_empty(self):
+        df = self._frame()
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        out = tfs.map_blocks(z, df)
+        np.testing.assert_allclose(
+            out.column("z").values, np.arange(5.0) + 3.0
+        )
+        assert out.offsets == df.offsets
+
+    def test_map_rows_skips_empty(self):
+        df = self._frame()
+        z = (tfs.row(df, "x") * 2.0).named("z")
+        out = tfs.map_rows(z, df)
+        np.testing.assert_allclose(out.column("z").values, np.arange(5.0) * 2)
+
+    def test_reduce_blocks_skips_empty(self):
+        df = self._frame()
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        assert float(tfs.reduce_blocks(s, df)) == 10.0
+
+    def test_reduce_rows_skips_empty(self):
+        df = self._frame()
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        s = (x1 + x2).named("x")
+        assert float(tfs.reduce_rows(s, df)) == 10.0
+
+    def test_trimmed_map_skips_empty(self):
+        df = self._frame()
+        x = tfs.block(df, "x")
+        z = dsl.reduce_sum(x, axes=[0], keep_dims=True).named("z")
+        out = tfs.map_blocks(z, df, trim=True)
+        np.testing.assert_allclose(out.column("z").values, [3.0, 7.0])
+
+    def test_all_blocks_empty(self):
+        from tensorframes_tpu.frame import Column, TensorFrame
+
+        df = TensorFrame(
+            [Column("x", np.zeros((0,)))], offsets=[0, 0, 0]
+        )
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        out = tfs.map_blocks(z, df)
+        assert out.nrows == 0

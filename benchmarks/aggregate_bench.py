@@ -1,0 +1,69 @@
+"""Distributed mean+variance benchmark (BASELINE config #4).
+
+One pass over float-vector rows: map squares, reduce [sum, sum-of-
+squares] — the associative-graph formulation the reference's
+`reduce_blocks` contract requires (`performReduceBlock` pairwise merges,
+`DebugRowOps.scala:879-904`) — then a keyed `aggregate` over the same
+data to exercise the groupBy path. Config #4 sizes to 100M rows; default
+here is 10M so the suite stays runnable on one host (scale with env).
+
+Sizes: AGG_ROWS (10_000_000), AGG_DIM (8), AGG_KEYS (16).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+import tensorframes_tpu as tfs  # noqa: E402
+from tensorframes_tpu import dsl  # noqa: E402
+
+
+def main():
+    rows = scaled("AGG_ROWS", 10_000_000)
+    dim = scaled("AGG_DIM", 8)
+    nkeys = scaled("AGG_KEYS", 16)
+    rng = np.random.RandomState(0)
+    data = rng.rand(rows, dim).astype(np.float32)
+
+    # ---- mean+variance via map + reduce_blocks ----------------------
+    df = tfs.TensorFrame.from_dict({"v": data}, num_blocks=8)
+    t0 = time.perf_counter()
+    v = tfs.block(df, "v")
+    squared = tfs.map_blocks(dsl.square(v).named("vsq"), df)
+    s = dsl.reduce_sum(
+        tfs.block(squared, "v", tf_name="v_input"), axes=[0]
+    ).named("v")
+    sq = dsl.reduce_sum(
+        tfs.block(squared, "vsq", tf_name="vsq_input"), axes=[0]
+    ).named("vsq")
+    total = np.asarray(tfs.reduce_blocks(s, squared))
+    total_sq = np.asarray(tfs.reduce_blocks(sq, squared))
+    dt = time.perf_counter() - t0
+    mean = total / rows
+    var = total_sq / rows - mean**2
+    np.testing.assert_allclose(mean, data.mean(0), rtol=1e-2)
+    np.testing.assert_allclose(var, data.var(0), rtol=1e-1)
+    emit("mean+variance reduce_blocks", rows / dt, "rows/s")
+
+    # ---- keyed aggregate (groupBy path) -----------------------------
+    keys = (np.arange(rows) % nkeys).astype(np.int64)
+    kdf = tfs.TensorFrame.from_dict({"k": keys, "v": data}, num_blocks=8)
+    sg = dsl.reduce_sum(
+        tfs.block(kdf, "v", tf_name="v_input"), axes=[0]
+    ).named("v")
+    t0 = time.perf_counter()
+    out = tfs.aggregate(sg, tfs.group_by(kdf, "k"))
+    np.asarray(out.column("v").values)
+    dt = time.perf_counter() - t0
+    emit(f"keyed aggregate sum ({nkeys} groups)", rows / dt, "rows/s")
+
+
+if __name__ == "__main__":
+    main()

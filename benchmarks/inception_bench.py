@@ -1,0 +1,52 @@
+"""Frozen Inception-style GraphDef scoring benchmark (BASELINE config #5).
+
+The reference's image-scoring sketch ships a frozen Inception-v3
+GraphDef to executors and scores image rows per partition
+(`tensorframes_snippets/read_image.py`). Here the frozen `InceptionLite`
+GraphDef crosses the same wire format (bytes -> import -> lowering) and
+scores an image-tensor column through `map_blocks`, riding the MXU for
+every conv. Measures images/sec.
+
+Sizes: INCEPTION_IMAGES (512), INCEPTION_SIZE (64), INCEPTION_WIDTH (16).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+import tensorframes_tpu as tfs  # noqa: E402
+from tensorframes_tpu.graph import builder as dsl_builder  # noqa: E402
+from tensorframes_tpu.models import InceptionLite  # noqa: E402
+
+
+def main():
+    images = scaled("INCEPTION_IMAGES", 512)
+    size = scaled("INCEPTION_SIZE", 64)
+    width = scaled("INCEPTION_WIDTH", 16)
+    rng = np.random.RandomState(0)
+    model = InceptionLite(image_size=size, width=width)
+    graph, fetches = dsl_builder.build(model.scoring_graph("images"))
+    wire = graph.to_bytes()  # the GraphDef interchange path
+
+    data = rng.rand(images, size, size, 3).astype(np.float32)
+    df = tfs.TensorFrame.from_dict({"images": data})
+
+    warm = tfs.TensorFrame.from_dict({"images": data[:8]})
+    tfs.map_blocks(wire, warm, fetch_names=fetches, trim=True)
+
+    t0 = time.perf_counter()
+    out = tfs.map_blocks(wire, df, fetch_names=fetches, trim=True)
+    np.asarray(out.column(fetches[0]).values)
+    dt = time.perf_counter() - t0
+    emit("InceptionLite frozen GraphDef scoring", images / dt, "images/s")
+
+
+if __name__ == "__main__":
+    main()

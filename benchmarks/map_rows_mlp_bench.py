@@ -1,0 +1,49 @@
+"""map_rows 3-layer MLP inference benchmark (BASELINE config #3).
+
+The reference runs one libtensorflow `session.run` PER ROW for map_rows
+(`performMapRows`, `DebugRowOps.scala:826-864`); here dense rows are
+vmap-batched into one XLA call per block, so the per-row graph rides the
+MXU as one batched matmul chain. Measures rows/sec through the public
+`map_rows` verb with the frozen MLP scoring GraphDef.
+
+Sizes: MLPROWS_ROWS (1_000_000), MLPROWS_DIM (64).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+import tensorframes_tpu as tfs  # noqa: E402
+from tensorframes_tpu.models import MLP  # noqa: E402
+
+
+def main():
+    rows = scaled("MLPROWS_ROWS", 1_000_000)
+    dim = scaled("MLPROWS_DIM", 64)
+    rng = np.random.RandomState(0)
+    data = rng.rand(rows, dim).astype(np.float32)
+    df = tfs.TensorFrame.from_dict({"features": data})
+
+    model = MLP([dim, 128, 128, 10], seed=0)
+    graph = model.scoring_graph("features", block=False)
+
+    # warm-up compiles the vmapped executable
+    warm = tfs.TensorFrame.from_dict({"features": data[:128]})
+    tfs.map_rows(graph, warm)
+
+    t0 = time.perf_counter()
+    out = tfs.map_rows(graph, df)
+    np.asarray(out.column("probs").values)  # force materialization
+    dt = time.perf_counter() - t0
+    emit("map_rows 3-layer MLP inference", rows / dt, "rows/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,11 @@ SMOKE_SIZES = {
     "KMEANS_ROWS": "5000",
     "KMEANS_DIM": "16",
     "KMEANS_ITERS": "3",
+    "MLPROWS_ROWS": "20000",
+    "AGG_ROWS": "100000",
+    "INCEPTION_IMAGES": "16",
+    "INCEPTION_SIZE": "32",
+    "INCEPTION_WIDTH": "8",
 }
 
 
@@ -27,7 +32,14 @@ def main():
             os.environ.setdefault(k, v)
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.dirname(here))
-    for mod in ("convert_bench", "map_sum_bench", "kmeans_bench"):
+    for mod in (
+        "convert_bench",
+        "map_sum_bench",
+        "kmeans_bench",
+        "map_rows_mlp_bench",
+        "aggregate_bench",
+        "inception_bench",
+    ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
 
 

@@ -175,3 +175,50 @@ class TestLogging:
 
         lg = tlog.get_logger("test")
         assert lg.name == "tensorframes_tpu.test"
+
+
+class TestBenchmarkSmoke:
+    """The benchmark suite (SURVEY §6: the reference's `ignore`d perf
+    harnesses, live here) must run end to end and emit parseable JSON."""
+
+    def test_run_all_smoke(self):
+        import json
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            BENCH_SMOKE="1",
+            CONVERT_CELLS="20000",
+            MAPSUM_ROWS="20000",
+            MAPSUM_ITERS="2",
+            KMEANS_ROWS="1000",
+            KMEANS_ITERS="2",
+            MLPROWS_ROWS="2000",
+            AGG_ROWS="20000",
+            INCEPTION_IMAGES="4",
+            INCEPTION_SIZE="32",
+            INCEPTION_WIDTH="8",
+        )
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import runpy; runpy.run_path("
+            f"{os.path.join(root, 'benchmarks', 'run_all.py')!r},"
+            "run_name='__main__')"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=600, env=env, cwd=root,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        metrics = [
+            json.loads(line)
+            for line in proc.stdout.splitlines()
+            if line.startswith("{")
+        ]
+        names = {m["metric"] for m in metrics}
+        assert len(metrics) >= 9, names
+        for m in metrics:
+            assert m["value"] > 0, m

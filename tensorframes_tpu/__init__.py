@@ -32,6 +32,7 @@ from .api import (
     block,
     block_to_row,
     explain,
+    cost_analysis,
     explain_detailed,
     group_by,
     map_blocks,
@@ -63,6 +64,7 @@ __all__ = [
     "block",
     "block_to_row",
     "explain",
+    "cost_analysis",
     "explain_detailed",
     "group_by",
     "map_blocks",

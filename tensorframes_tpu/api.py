@@ -1093,6 +1093,61 @@ def explain_detailed(frame: TensorFrame):
     return frame.info
 
 
+def cost_analysis(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+) -> Dict[str, float]:
+    """XLA's cost model for the compiled program `map_blocks` would run.
+
+    The reference's protos carry `StepStats`/`NodeExecStats` but nothing
+    consumes them (SURVEY §5 "tracing: absent"); here the compiler itself
+    is the cost oracle. Returns per-block-call estimates from the
+    compiled executable: ``flops``, ``bytes_accessed`` (HBM traffic),
+    ``argument_bytes``/``output_bytes``/``temp_bytes`` (from the memory
+    analysis), plus ``block_rows`` and derived ``flops_per_row`` — enough
+    to predict MXU vs HBM-bandwidth-bound behavior before running at
+    scale. The compile is cached by jax, so a following `map_blocks`
+    call reuses it.
+    """
+    if _is_pandas(frame):
+        frame = TensorFrame.from_pandas(frame)
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _require_dense(frame, list(mapping.values()), "cost_analysis")
+    feed_names = sorted(summary.inputs)
+    from .ops.lowering import build_callable as _bc
+
+    fn = _bc(graph, fetch_list, feed_names)
+    # shapes come from the first non-empty block
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo != hi:
+            break
+    else:
+        raise ValueError("cost_analysis: frame has no non-empty block")
+    feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
+    compiled = jax.jit(fn).lower(*feeds).compile()
+    ca = compiled.cost_analysis() or {}
+    mem = compiled.memory_analysis()
+    rows = hi - lo
+    flops = float(ca.get("flops", 0.0))
+    return {
+        "flops": flops,
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "argument_bytes": float(
+            getattr(mem, "argument_size_in_bytes", 0) or 0
+        ),
+        "output_bytes": float(getattr(mem, "output_size_in_bytes", 0) or 0),
+        "temp_bytes": float(getattr(mem, "temp_size_in_bytes", 0) or 0),
+        "block_rows": float(rows),
+        "flops_per_row": flops / rows if rows else 0.0,
+    }
+
+
 def block_to_row(frame: TensorFrame) -> TensorFrame:
     """Convert each block to a single row, augmenting every column's rank
     by one (lead dim = block row count).

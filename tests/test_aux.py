@@ -222,3 +222,41 @@ class TestBenchmarkSmoke:
         assert len(metrics) >= 9, names
         for m in metrics:
             assert m["value"] > 0, m
+
+
+class TestCostAnalysis:
+    """XLA cost model surfaced per compiled verb program (SURVEY §5:
+    the reference has StepStats protos but nothing consumes them)."""
+
+    def test_matmul_flops_scale(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.random.RandomState(0).rand(64, 32).astype(np.float32)}
+        )
+        from tensorframes_tpu import dsl
+
+        w = dsl.constant(np.ones((32, 16), np.float32), name="w")
+        z = dsl.matmul(tfs.block(df, "x"), w).named("z")
+        cost = tfs.cost_analysis(z, df)
+        # 64x32 @ 32x16 = 2*64*32*16 = 65536 flops at minimum
+        assert cost["flops"] >= 2 * 64 * 32 * 16
+        assert cost["block_rows"] == 64
+        assert cost["flops_per_row"] == cost["flops"] / 64
+        assert cost["bytes_accessed"] > 0
+
+    def test_elementwise_is_bandwidth_bound(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(1024, dtype=np.float32)}
+        )
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        cost = tfs.cost_analysis(z, df)
+        # x+3 over 1024 floats: ~1 flop/elem, >= 8 bytes/elem moved
+        assert cost["flops"] <= 4 * 1024
+        assert cost["bytes_accessed"] >= 2 * 4 * 1024
+
+    def test_empty_frame_rejected(self):
+        from tensorframes_tpu.frame import Column, TensorFrame
+
+        df = TensorFrame([Column("x", np.zeros((0,)))], offsets=[0, 0])
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        with pytest.raises(ValueError, match="no non-empty block"):
+            tfs.cost_analysis(z, df)

@@ -519,10 +519,13 @@ def _gather(ctx, node, inputs):
 @register("OneHot")
 def _one_hot(ctx, node, inputs):
     depth = int(ctx.static(inputs[1], node, "depth"))
-    on = inputs[2] if len(inputs) > 2 else 1.0
-    off = inputs[3] if len(inputs) > 3 else 0.0
+    on = jnp.asarray(inputs[2]) if len(inputs) > 2 else jnp.float32(1.0)
+    off = jnp.asarray(inputs[3]) if len(inputs) > 3 else jnp.float32(0.0)
     axis = int(node.attr("axis", -1))
-    oh = jax.nn.one_hot(jnp.asarray(inputs[0]), depth, axis=axis)
+    # output dtype follows on/off_value (TF's T attr), not the x64 default
+    oh = jax.nn.one_hot(
+        jnp.asarray(inputs[0]), depth, axis=axis, dtype=on.dtype
+    )
     return oh * on + (1 - oh) * off
 
 
@@ -592,9 +595,12 @@ def _conv2d(ctx, node, inputs):
 def _depthwise_conv(ctx, node, inputs):
     x, w = jnp.asarray(inputs[0]), jnp.asarray(inputs[1])
     strides = [int(s) for s in node.attrs["strides"].value.i]
-    # w: [H, W, C, M] -> depthwise = feature_group_count=C with [H,W,1,C*M]
+    # w: [H, W, C, M] -> grouped conv, feature_group_count=C, [H,W,1,C*M].
+    # Output channel o = c*M + m belongs to group o // M = c, so the
+    # filter reshapes channel-major — no transpose (TF orders outputs
+    # [c0m0, c0m1, c1m0, ...]).
     h, wd, c, m = w.shape
-    w2 = jnp.reshape(jnp.transpose(w, (0, 1, 3, 2)), (h, wd, 1, c * m))
+    w2 = jnp.reshape(w, (h, wd, 1, c * m))
     dn = lax.conv_dimension_numbers(x.shape, w2.shape, ("NHWC", "HWIO", "NHWC"))
     return lax.conv_general_dilated(
         x, w2, strides[1:3], _padding_str(node),

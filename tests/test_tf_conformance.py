@@ -271,3 +271,171 @@ class TestDtypeSemanticsParity:
             {"x": np.random.RandomState(0).rand(3, 5).astype(np.float32)},
             "z",
         )
+
+
+class TestExtendedOpParity:
+    """Broader op-matrix conformance: NN inference ops, gather/scatter,
+    layout ops — each case is real-TF-built wire bytes through our
+    parser + lowering vs a TF session."""
+
+    def test_depthwise_conv(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 8, 8, 3], name="x")
+            w = tf.constant(
+                np.random.RandomState(0).rand(3, 3, 3, 2).astype(np.float32)
+            )
+            tf.nn.depthwise_conv2d(
+                x, w, strides=[1, 1, 1, 1], padding="SAME", name="z"
+            )
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(1).rand(2, 8, 8, 3).astype(np.float32)},
+            "z", rtol=1e-4,
+        )
+
+    def test_fused_batch_norm_inference(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 4, 4, 3], name="x")
+            y = tf.nn.fused_batch_norm(
+                x,
+                scale=tf.constant([1.0, 2.0, 0.5]),
+                offset=tf.constant([0.1, -0.1, 0.0]),
+                mean=tf.constant([0.5, 0.4, 0.3]),
+                variance=tf.constant([1.0, 2.0, 0.25]),
+                is_training=False,
+            )[0]
+            tf.identity(y, name="z")
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(2).rand(2, 4, 4, 3).astype(np.float32)},
+            "z", rtol=1e-4,
+        )
+
+    def test_batch_matmul(self):
+        def build(tf):
+            a = tf.placeholder(tf.float32, [None, 3, 4], name="a")
+            b = tf.placeholder(tf.float32, [None, 4, 2], name="b")
+            tf.matmul(a, b, name="z")
+
+        rng = np.random.RandomState(3)
+        assert_match(
+            build,
+            {
+                "a": rng.rand(2, 3, 4).astype(np.float32),
+                "b": rng.rand(2, 4, 2).astype(np.float32),
+            },
+            "z", rtol=1e-5,
+        )
+
+    def test_transpose_tile(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 3], name="x")
+            t = tf.transpose(x, [1, 0])
+            tf.tile(t, [2, 1], name="z")
+
+        assert_match(build, {"x": np.arange(6.0).reshape(2, 3)}, "z")
+
+    def test_gather(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 2], name="x")
+            idx = tf.constant([2, 0, 2], tf.int32)
+            tf.gather(x, idx, name="z")
+
+        assert_match(build, {"x": np.arange(8.0).reshape(4, 2)}, "z")
+
+    def test_one_hot(self):
+        def build(tf):
+            i = tf.placeholder(tf.int32, [None], name="i")
+            tf.one_hot(i, 4, name="z")
+
+        assert_match(build, {"i": np.array([1, 3, 0], np.int32)}, "z")
+
+    def test_select_clip(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None], name="x")
+            sel = tf.where(x > 0.0, x, -x)
+            tf.clip_by_value(sel, 0.5, 2.0, name="z")
+
+        assert_match(
+            build, {"x": np.linspace(-3, 3, 7, dtype=np.float32)}, "z"
+        )
+
+    def test_split_unpack(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 6], name="x")
+            a, b, c = tf.split(x, 3, axis=1)
+            parts = tf.unstack(a + c, axis=1)
+            tf.add(parts[0], parts[1], name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(12, dtype=np.float32).reshape(2, 6)},
+            "z",
+        )
+
+    def test_mirror_pad(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 3], name="x")
+            tf.pad(x, [[1, 1], [1, 0]], mode="REFLECT", name="z")
+
+        assert_match(build, {"x": np.arange(6.0).reshape(2, 3)}, "z")
+
+    def test_expand_range_fill_broadcast(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None], name="x")
+            r = tf.cast(tf.range(4), tf.float32)
+            e = tf.expand_dims(x, -1)  # (N,1)
+            f = tf.fill([4], 2.0)
+            tf.identity(e * r + f, name="z")
+
+        assert_match(build, {"x": np.arange(3.0, dtype=np.float32)}, "z")
+
+    def test_log_softmax(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 5], name="x")
+            tf.nn.log_softmax(x, name="z")
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(4).rand(3, 5).astype(np.float32)},
+            "z", rtol=1e-5,
+        )
+
+    def test_slice_dynamic_lead(self):
+        def build(tf):
+            x = tf.placeholder(tf.float64, [None, 4], name="x")
+            tf.slice(x, [1, 1], [2, 2], name="z")
+
+        assert_match(build, {"x": np.arange(16.0).reshape(4, 4)}, "z")
+
+    def test_dilated_conv(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 9, 9, 1], name="x")
+            w = tf.constant(
+                np.random.RandomState(5).rand(3, 3, 1, 2).astype(np.float32)
+            )
+            tf.nn.conv2d(
+                x, w, strides=[1, 1, 1, 1], padding="SAME",
+                dilations=[1, 2, 2, 1], name="z",
+            )
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(6).rand(1, 9, 9, 1).astype(np.float32)},
+            "z", rtol=1e-4,
+        )
+
+    def test_lrn(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 2, 2, 8], name="x")
+            tf.nn.local_response_normalization(
+                x, depth_radius=2, bias=1.0, alpha=0.5, beta=0.75, name="z"
+            )
+
+        assert_match(
+            build,
+            {"x": np.random.RandomState(7).rand(1, 2, 2, 8).astype(np.float32)},
+            "z", rtol=1e-4,
+        )

@@ -1,0 +1,31 @@
+# Build/CI entry points (the reference's L10: sbt projects + run-tests.sh
+# + travis matrix, SURVEY.md §1). Everything runs from a bare checkout.
+
+PY ?= python
+
+.PHONY: test test-fast bench bench-smoke native lint dryrun all
+
+all: native test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+# headline metric on whatever backend is live (real chip under axon)
+bench:
+	$(PY) bench.py
+
+# full benchmark suite at smoke sizes (CPU-safe)
+bench-smoke:
+	BENCH_SMOKE=1 JAX_PLATFORMS=cpu $(PY) -c "import jax; jax.config.update('jax_platforms','cpu'); import runpy; runpy.run_path('benchmarks/run_all.py', run_name='__main__')"
+
+# C++ runtime: GraphDef parser, conversion kernels, PJRT host
+native:
+	$(MAKE) -C native
+
+# driver entry points: single-chip compile check + virtual multi-chip dry run
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; fn, a = g.entry(); import jax; jax.jit(fn)(*a); print('entry ok')"
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

@@ -47,6 +47,7 @@ from .graph import Graph, ShapeHints
 from .graph import builder as dsl
 from .runtime import Executor
 from . import config
+from . import io
 from . import utils
 
 __all__ = [

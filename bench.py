@@ -18,14 +18,45 @@ import time
 import numpy as np
 
 
+def _backend_is_healthy(timeout_s: float) -> bool:
+    """Probe accelerator init in a CHILD process: a wedged chip claim (a
+    killed claimant can leak the grant through the pool relay) hangs
+    `jax.devices()` indefinitely, and that must not hang the bench."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import jax
+
+    probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+    degraded = False
+    if not _backend_is_healthy(probe_s):
+        # measure on CPU rather than hang; the metric line says so
+        jax.config.update("jax_platforms", "cpu")
+        degraded = True
+        print(
+            f"# accelerator init unresponsive after {probe_s:.0f}s; "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
 
     import tensorframes_tpu as tfs
 
     n = int(os.environ.get("BENCH_ROWS", 10_000_000))
     num_blocks = int(os.environ.get("BENCH_BLOCKS", 1))
     platform = jax.devices()[0].platform
+    if degraded:
+        platform += "-fallback"
 
     df = tfs.TensorFrame.from_dict(
         {"x": np.arange(n, dtype=np.float32)}, num_blocks=num_blocks

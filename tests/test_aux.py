@@ -260,3 +260,53 @@ class TestCostAnalysis:
         z = (tfs.block(df, "x") + 1.0).named("z")
         with pytest.raises(ValueError, match="no non-empty block"):
             tfs.cost_analysis(z, df)
+
+
+class TestShardedCheckpoint:
+    """Checkpoint/resume for mesh-sharded params: a distributed training
+    state must restore with its shardings intact (SURVEY §5 designed-
+    fresh subsystem; the reference has no checkpointing at all)."""
+
+    def test_sharded_params_roundtrip(self, tmp_path):
+        import jax
+
+        from tensorframes_tpu.models import MLP
+        from tensorframes_tpu.parallel import mesh_2d
+
+        mesh = mesh_2d(2, 2)
+        model = MLP([8, 16, 4], seed=0)
+        sharded = model.shard_params(model.params, mesh)
+        path = str(tmp_path / "ckpt")
+        save_params(path, sharded)
+        restored = load_params(path, like=sharded)
+
+        flat_a = jax.tree_util.tree_leaves(sharded)
+        flat_b = jax.tree_util.tree_leaves(restored)
+        assert len(flat_a) == len(flat_b)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            if hasattr(a, "sharding") and hasattr(b, "sharding"):
+                assert a.sharding.is_equivalent_to(b.sharding, a.ndim), (
+                    a.sharding, b.sharding,
+                )
+
+    def test_training_resumes_identically(self, tmp_path):
+        from tensorframes_tpu.models import MLP
+        from tensorframes_tpu.parallel import mesh_2d
+
+        mesh = mesh_2d(2, 2)
+        model = MLP([8, 16, 4], seed=1)
+        step = model.sharded_train_step(mesh, lr=0.1)
+        params = model.shard_params(model.params, mesh)
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 8).astype(np.float32)
+        y = rng.randint(0, 4, 8)
+
+        params, _ = step(params, x, y)
+        path = str(tmp_path / "mid")
+        save_params(path, params)
+        params, loss_a = step(params, x, y)
+
+        resumed = load_params(path, like=params)
+        resumed, loss_b = step(resumed, x, y)
+        np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)

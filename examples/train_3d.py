@@ -1,0 +1,70 @@
+"""Train a TransformerLM with DP x SP x TP combined — one jitted step.
+
+The ("data","seq","model") mesh carries all three axes at once: batch
+shards over data, ring attention shards the sequence over seq (K/V
+rotate on ICI), and Megatron-style column/row weight splits shard
+heads/FFN/vocab over model with psum combines. Runs on the 8-device
+virtual CPU mesh anywhere; on a real slice the same code spans chips.
+
+    python examples/train_3d.py --steps 20
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main(steps: int, dp: int, sp: int, mp: int):
+    from tensorframes_tpu.utils import force_virtual_cpu_devices
+
+    n = dp * sp * mp
+    force_virtual_cpu_devices(n)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tensorframes_tpu.models import TransformerLM
+
+    mesh = Mesh(
+        np.asarray(jax.devices()[:n]).reshape(dp, sp, mp),
+        ("data", "seq", "model"),
+    )
+    model = TransformerLM(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, max_seq=256
+    )
+    step = model.sharded_train_step_3d(mesh, lr=0.1)
+    layout = model.device_layout(model.params)
+
+    rng = np.random.RandomState(0)
+    # a tiny copy-structure corpus: token t+1 = (t + 1) % 7
+    base = np.arange(dp * 2 * sp * 32).reshape(dp * 2, sp * 32) % 7
+    toks = jnp.asarray(base, jnp.int32)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        layout, loss = step(layout, toks)
+        if i % max(1, steps // 10) == 0 or i == steps - 1:
+            print(f"step {i:3d}  loss {float(loss):.4f}")
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    print(
+        f"{steps} steps on a {dp}x{sp}x{mp} (data,seq,model) mesh "
+        f"in {dt:.2f}s ({dt / steps * 1e3:.1f} ms/step)"
+    )
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--mp", type=int, default=2)
+    a = ap.parse_args()
+    main(a.steps, a.dp, a.sp, a.mp)

@@ -39,7 +39,7 @@ import jax
 import numpy as np
 from jax import lax
 
-from .frame import Column, TensorFrame
+from .frame import Column, TensorFrame, factorize_keys
 from .graph import builder as dsl
 from .graph.analysis import GraphSummary, ShapeHints, analyze_graph
 from .graph.ir import Graph, parse_edge
@@ -1002,21 +1002,7 @@ def aggregate(
 
     # --- factorize keys (host; the Catalyst shuffle analogue) ----------
     key_arrays = [frame.column(k).values for k in grouped.keys]
-    if len(key_arrays) == 1:
-        uniq, inverse = np.unique(key_arrays[0], return_inverse=True)
-        key_out = {grouped.keys[0]: uniq}
-    else:
-        stacked_keys = np.stack(
-            [np.asarray(a).astype(object, copy=False) for a in key_arrays], 1
-        )
-        _, first_idx, inverse = np.unique(
-            np.array([tuple(r) for r in stacked_keys], dtype=object),
-            return_index=True,
-            return_inverse=True,
-        )
-        key_out = {
-            k: key_arrays[i][first_idx] for i, k in enumerate(grouped.keys)
-        }
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
     num_groups = len(next(iter(key_out.values())))
     order = np.argsort(inverse, kind="stable")
     sorted_gid = inverse[order]

@@ -440,3 +440,33 @@ class TensorFrame:
             f"TensorFrame[{self.nrows} rows x {len(self._cols)} cols, "
             f"{self.num_blocks} blocks]({', '.join(map(repr, self.info))})"
         )
+
+
+def factorize_keys(key_names, key_arrays):
+    """Factorize one or more group-key columns into
+    (key_out: name -> unique values aligned per group, inverse: row -> gid).
+
+    Multi-key tuples combine per-key codes mixed-radix into one int64 per
+    row (np.unique cannot handle 2-D object arrays), the host-side
+    analogue of the Catalyst shuffle key (`DebugRowOps.scala:554-599`).
+    """
+    if len(key_arrays) == 1:
+        uniq, inverse = np.unique(key_arrays[0], return_inverse=True)
+        return {key_names[0]: uniq}, inverse
+    per_key = [np.unique(a, return_inverse=True) for a in key_arrays]
+    combo = np.zeros(len(key_arrays[0]), np.int64)
+    for u, inv in per_key:
+        radix = max(len(u), 1)
+        if combo.max(initial=0) > (2**62) // radix:
+            raise ValueError(
+                "aggregate: combined group-key cardinality overflows"
+            )
+        combo = combo * radix + inv
+    _, first_idx, inverse = np.unique(
+        combo, return_index=True, return_inverse=True
+    )
+    key_out = {
+        k: np.asarray(key_arrays[i])[first_idx]
+        for i, k in enumerate(key_names)
+    }
+    return key_out, inverse

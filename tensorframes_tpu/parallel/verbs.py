@@ -387,20 +387,10 @@ def aggregate(
     _api._require_dense(frame, list(mapping.values()), "aggregate")
 
     # host: factorize keys once (global key table)
+    from ..frame import factorize_keys
+
     key_arrays = [frame.column(k).values for k in grouped.keys]
-    if len(key_arrays) == 1:
-        uniq, inverse = np.unique(key_arrays[0], return_inverse=True)
-        key_out = {grouped.keys[0]: uniq}
-    else:
-        stacked_keys = np.stack([np.asarray(a) for a in key_arrays], 1)
-        uniq_rows, first_idx, inverse = np.unique(
-            np.array([tuple(r) for r in stacked_keys], dtype=object),
-            return_index=True,
-            return_inverse=True,
-        )
-        key_out = {
-            k: key_arrays[i][first_idx] for i, k in enumerate(grouped.keys)
-        }
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
     num_keys = len(next(iter(key_out.values())))
     gid = inverse.astype(np.int32)
 

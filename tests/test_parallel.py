@@ -235,3 +235,29 @@ class TestDistributedBindings:
             "Lloyd iterations with bound centers must reuse the compiled "
             "executable"
         )
+
+
+class TestMultiKeyAggregateMesh:
+    def test_two_keys_over_mesh(self, mesh):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict(
+            {
+                "a": np.tile(np.array([0, 1]), 8),
+                "b": np.repeat(np.array([0, 1]), 8),
+                "x": np.arange(16.0),
+            }
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "a", "b"), mesh=mesh)
+        pdf = out.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+        data = np.arange(16.0)
+        expect = [
+            data[(np.tile([0, 1], 8) == a) & (np.repeat([0, 1], 8) == b)].sum()
+            for a in (0, 1)
+            for b in (0, 1)
+        ]
+        assert pdf["x"].tolist() == expect

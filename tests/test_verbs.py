@@ -527,3 +527,53 @@ class TestEmptyBlocks:
         z = (tfs.block(df, "x") + 3.0).named("z")
         out = tfs.map_blocks(z, df)
         assert out.nrows == 0
+
+
+class TestMultiKeyAggregate:
+    """groupBy over several key columns (the reference's
+    `df.groupBy(k1, k2).agg`, reachable through `RelationalGroupedDataset`)."""
+
+    def test_two_int_keys(self):
+        df = frame_of(
+            a=np.array([0, 0, 1, 1, 0]),
+            b=np.array([0, 1, 0, 1, 0]),
+            x=np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "a", "b")).to_pandas()
+        out = out.sort_values(["a", "b"]).reset_index(drop=True)
+        assert out["x"].tolist() == [6.0, 2.0, 3.0, 4.0]
+        assert out["a"].tolist() == [0, 0, 1, 1]
+        assert out["b"].tolist() == [0, 1, 0, 1]
+
+    def test_mixed_dtype_keys(self):
+        df = frame_of(
+            g=np.array([1.5, 1.5, 2.5]),
+            h=np.array([7, 8, 7]),
+            x=np.array([1.0, 2.0, 3.0]),
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "g", "h")).to_pandas()
+        out = out.sort_values(["g", "h"]).reset_index(drop=True)
+        assert out["x"].tolist() == [1.0, 2.0, 3.0]
+
+    def test_three_keys_vector_values(self):
+        df = frame_of(
+            a=np.array([0, 0, 0, 1]),
+            b=np.array([0, 0, 1, 0]),
+            c=np.array([5, 5, 5, 5]),
+            v=np.arange(8.0).reshape(4, 2),
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "v", tf_name="v_input"), axes=[0]
+        ).named("v")
+        out = tfs.aggregate(s, tfs.group_by(df, "a", "b", "c"))
+        pdf = out.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+        np.testing.assert_allclose(
+            np.stack(pdf["v"].to_numpy()),
+            np.array([[2.0, 4.0], [4.0, 5.0], [6.0, 7.0]]),
+        )

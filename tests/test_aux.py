@@ -310,3 +310,71 @@ class TestShardedCheckpoint:
         resumed = load_params(path, like=params)
         resumed, loss_b = step(resumed, x, y)
         np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+
+
+class TestThreadSafety:
+    """Race-detection coverage (SURVEY §5): the reference documents its
+    DSL as thread-UNSAFE (`Paths.scala:10-12`) and disables parallel test
+    execution as mitigation. Here concurrent graph building and verb
+    execution must be correct by construction."""
+
+    def test_concurrent_dsl_building(self):
+        import threading
+
+        from tensorframes_tpu import dsl
+        from tensorframes_tpu.graph import builder
+
+        errors = []
+
+        def build_one(tid):
+            try:
+                for i in range(20):
+                    with builder.scope(f"t{tid}"):
+                        x = dsl.placeholder(
+                            tfs.ScalarType.float64, tfs.Shape((None,)),
+                            name=f"x{tid}_{i}",
+                        )
+                        z = (x + float(tid)).named(f"z{tid}_{i}")
+                        g, fetches = builder.build(z)
+                        names = {n.name for n in g.nodes}
+                        assert any(f"x{tid}_{i}" in n for n in names), names
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=build_one, args=(t,)) for t in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+
+    def test_concurrent_verb_execution_shared_executor(self):
+        import threading
+
+        from tensorframes_tpu import dsl
+
+        errors = []
+
+        def run_one(tid):
+            try:
+                data = np.arange(64.0) + tid
+                df = tfs.TensorFrame.from_dict({"x": data}, num_blocks=4)
+                z = (tfs.block(df, "x") * 2.0).named("z")
+                for _ in range(5):
+                    out = tfs.map_blocks(z, df)
+                    np.testing.assert_allclose(
+                        out.column("z").values, data * 2.0
+                    )
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run_one, args=(t,)) for t in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors

@@ -45,6 +45,7 @@ from .graph.analysis import GraphSummary, ShapeHints, analyze_graph
 from .graph.ir import Graph, parse_edge
 from .ops.lowering import build_callable
 from .runtime.executor import Executor, default_executor
+from .runtime.retry import maybe_check_numerics
 from .schema import Shape
 
 __all__ = [
@@ -418,6 +419,7 @@ def map_blocks(
             attempts=_config.get().block_retry_attempts,
             what=f"map_blocks block {bi}",
         )
+        maybe_check_numerics(fetch_list, outs, f"map_blocks block {bi}")
         bsize = None
         for f, o in zip(fetch_list, outs):
             # keep device arrays on device; shape checks are metadata-only
@@ -563,6 +565,7 @@ def map_rows(
             if lo == hi:
                 continue
             outs = vfn(*[frame.column(c).values[lo:hi] for c in cols_used])
+            maybe_check_numerics(out_names, outs, f"map_rows block {bi}")
             for n, o in zip(out_names, outs):
                 acc[n].append(o)
         out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
@@ -708,6 +711,7 @@ def reduce_blocks(
         if lo == hi:
             continue
         outs = fn(*[frame.column(mapping[n]).values[lo:hi] for n in feed_names])
+        maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
         partials.append(tuple(np.asarray(o) for o in outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -930,6 +934,7 @@ def reduce_rows(
             partials.append(tuple(np.asarray(cols[b][0]) for b in bases))
         else:
             outs = jfold(cols)
+            maybe_check_numerics(bases, outs, f"reduce_rows block {bi}")
             partials.append(tuple(np.asarray(o) for o in outs))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
@@ -1032,6 +1037,7 @@ def aggregate(
         row_idx = starts[gids][:, None] + np.arange(size)[None, :]
         feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
         outs = vraw(*feeds)
+        maybe_check_numerics(bases, outs, f"aggregate groups of size {size}")
         for b, o in zip(bases, outs):
             o = np.asarray(o)
             if out_buffers[b] is None:

@@ -42,6 +42,8 @@ class Config:
     aggregate_buffer_rows: int = 10
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0
+    # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).
+    check_numerics: bool = False
 
     def lax_precision(self):
         from jax import lax

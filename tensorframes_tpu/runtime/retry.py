@@ -35,3 +35,28 @@ def run_with_retries(fn: Callable, *args, attempts: int = 0, what: str = "block"
                 what, attempt + 1, attempts + 1, e,
             )
     raise AssertionError("unreachable")
+
+
+def maybe_check_numerics(fetch_names, outs, what: str):
+    """Debug-mode numerics guard (``tfs.config.update(check_numerics=True)``):
+    raise FloatingPointError naming the verb, block, and fetch when an
+    output contains NaN/Inf — the role `CheckNumerics` nodes play in the
+    reference's graphs, applied to every fetch without editing the graph.
+    Costs one device sync per checked call; off by default."""
+    from .. import config
+
+    if not config.get().check_numerics:
+        return
+    import jax.numpy as jnp
+    import numpy as np
+
+    for name, o in zip(fetch_names, outs):
+        arr = jnp.asarray(o)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        if not bool(jnp.all(jnp.isfinite(arr))):
+            bad = int(np.sum(~np.asarray(jnp.isfinite(arr))))
+            raise FloatingPointError(
+                f"{what}: fetch {name!r} contains {bad} non-finite "
+                "value(s) (check_numerics is on)"
+            )

@@ -378,3 +378,40 @@ class TestThreadSafety:
         for t in threads:
             t.join()
         assert not errors, errors
+
+
+class TestCheckNumerics:
+    """config.check_numerics: the CheckNumerics role for every fetch
+    without editing the graph — names the verb, block, and fetch."""
+
+    def test_map_blocks_nan_raises(self):
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1.0, 0.0, 4.0])}, num_blocks=1
+        )
+        x = tfs.block(df, "x")
+        z = (x / (x - x)).named("z")  # 0/0 -> nan
+        with config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="map_blocks.*'z'"):
+                tfs.map_blocks(z, df)
+        # off by default: same graph runs fine
+        out = tfs.map_blocks(z, df)
+        assert np.isnan(np.asarray(out.column("z").values)[1])
+
+    def test_reduce_blocks_inf_raises(self):
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict({"x": np.array([1e308, 1e308])})
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="reduce_blocks"):
+                tfs.reduce_blocks(s, df)
+
+    def test_integer_outputs_ignored(self):
+        df = tfs.TensorFrame.from_dict({"x": np.array([1, 2, 3])})
+        with config.override(check_numerics=True):
+            out = tfs.map_blocks(lambda x: {"z": x + 1}, df)
+        assert out.column("z").values.tolist() == [2, 3, 4]

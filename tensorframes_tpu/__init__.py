@@ -33,6 +33,7 @@ from .api import (
     block_to_row,
     explain,
     cost_analysis,
+    explain_hlo,
     explain_detailed,
     group_by,
     map_blocks,
@@ -66,6 +67,7 @@ __all__ = [
     "block_to_row",
     "explain",
     "cost_analysis",
+    "explain_hlo",
     "explain_detailed",
     "group_by",
     "map_blocks",

@@ -1085,6 +1085,57 @@ def explain_detailed(frame: TensorFrame):
     return frame.info
 
 
+def _lower_for_inspection(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]],
+    fetch_names: Optional[Sequence[str]],
+    what: str,
+):
+    """Shared plumbing for `cost_analysis` / `explain_hlo`: lower the
+    exact program `map_blocks` would run for the first non-empty block."""
+    if _is_pandas(frame):
+        frame = TensorFrame.from_pandas(frame)
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
+    _require_dense(frame, list(mapping.values()), what)
+    feed_names = sorted(summary.inputs)
+    from .ops.lowering import build_callable as _bc
+
+    fn = _bc(graph, fetch_list, feed_names)
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo != hi:
+            break
+    else:
+        raise ValueError(f"{what}: frame has no non-empty block")
+    feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
+    return jax.jit(fn).lower(*feeds), hi - lo
+
+
+def explain_hlo(
+    fetches: Fetches,
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    optimized: bool = False,
+) -> str:
+    """The HLO text of the program `map_blocks` would run — StableHLO as
+    lowered (default) or the backend-optimized HLO after XLA's fusion
+    passes (``optimized=True``). The inspection surface the reference
+    could not offer (its executor was an opaque libtensorflow session);
+    pairs with `cost_analysis` for the quantitative view.
+    """
+    lowered, _ = _lower_for_inspection(
+        fetches, frame, feed_dict, fetch_names, what="explain_hlo"
+    )
+    if optimized:
+        return lowered.compile().as_text()
+    return lowered.as_text()
+
+
 def cost_analysis(
     fetches: Fetches,
     frame: TensorFrame,
@@ -1103,29 +1154,12 @@ def cost_analysis(
     scale. The compile is cached by jax, so a following `map_blocks`
     call reuses it.
     """
-    if _is_pandas(frame):
-        frame = TensorFrame.from_pandas(frame)
-    graph, fetch_list = _as_graph(fetches, fetch_names)
-    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
-    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
-    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
-    _require_dense(frame, list(mapping.values()), "cost_analysis")
-    feed_names = sorted(summary.inputs)
-    from .ops.lowering import build_callable as _bc
-
-    fn = _bc(graph, fetch_list, feed_names)
-    # shapes come from the first non-empty block
-    for bi in range(frame.num_blocks):
-        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-        if lo != hi:
-            break
-    else:
-        raise ValueError("cost_analysis: frame has no non-empty block")
-    feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
-    compiled = jax.jit(fn).lower(*feeds).compile()
+    lowered, rows = _lower_for_inspection(
+        fetches, frame, feed_dict, fetch_names, what="cost_analysis"
+    )
+    compiled = lowered.compile()
     ca = compiled.cost_analysis() or {}
     mem = compiled.memory_analysis()
-    rows = hi - lo
     flops = float(ca.get("flops", 0.0))
     return {
         "flops": flops,

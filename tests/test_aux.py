@@ -415,3 +415,23 @@ class TestCheckNumerics:
         with config.override(check_numerics=True):
             out = tfs.map_blocks(lambda x: {"z": x + 1}, df)
         assert out.column("z").values.tolist() == [2, 3, 4]
+
+
+class TestExplainHlo:
+    def test_stablehlo_text(self):
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        txt = tfs.explain_hlo(z, df)
+        assert "stablehlo" in txt or "mhlo" in txt or "func" in txt
+        assert "add" in txt
+
+    def test_optimized_hlo_fuses(self):
+        from tensorframes_tpu import dsl
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        x = tfs.block(df, "x")
+        z = ((x + 1.0) * 2.0).named("z")
+        txt = tfs.explain_hlo(z, df, optimized=True)
+        assert "HloModule" in txt

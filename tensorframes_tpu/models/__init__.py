@@ -5,6 +5,7 @@ from .inception import InceptionLite
 from .kmeans import kmeans
 from .mlp import MLP
 from .moe import MoEFFN
+from .training import init_opt_state, make_train_step
 from .transformer import TransformerLM
 
-__all__ = ["MLP", "kmeans", "TransformerLM", "InceptionLite", "MoEFFN"]
+__all__ = ["MLP", "kmeans", "TransformerLM", "InceptionLite", "MoEFFN", "make_train_step", "init_opt_state"]

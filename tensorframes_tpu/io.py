@@ -29,9 +29,12 @@ def write_arrow_ipc(frame: TensorFrame, path: str) -> None:
         with pa.ipc.new_file(sink, table.schema) as writer:
             for bi in range(frame.num_blocks):
                 lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-                if lo == hi:
-                    continue
-                writer.write_table(table.slice(lo, hi - lo))
+                # zero-row batches keep empty blocks through the round trip
+                writer.write_batch(
+                    pa.RecordBatch.from_struct_array(
+                        table.slice(lo, hi - lo).to_struct_array().combine_chunks()
+                    )
+                )
 
 
 def read_arrow_ipc(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
@@ -41,11 +44,11 @@ def read_arrow_ipc(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
 
     with pa.OSFile(path, "rb") as source:
         reader = pa.ipc.open_file(source)
-        table = reader.read_all()
-        batch_rows = [
-            reader.get_batch(bi).num_rows
-            for bi in range(reader.num_record_batches)
+        batches = [
+            reader.get_batch(bi) for bi in range(reader.num_record_batches)
         ]
+        table = pa.Table.from_batches(batches, schema=reader.schema)
+        batch_rows = [b.num_rows for b in batches]
     if num_blocks is not None:
         return TensorFrame.from_arrow(table, num_blocks=num_blocks)
     frame = TensorFrame.from_arrow(table)

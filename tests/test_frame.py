@@ -225,6 +225,29 @@ class TestArrowIPC:
         np.testing.assert_array_equal(back.column("v").values, df.column("v").values)
         assert back.offsets == df.offsets
 
+    def test_empty_blocks_preserved(self, tmp_path):
+        # empty blocks become zero-row record batches and survive the
+        # round trip (round-1 advisor finding: they were silently dropped)
+        from tensorframes_tpu import io as tio
+
+        df = TensorFrame.from_dict({"x": np.arange(6.0)})
+        df.offsets = [0, 3, 3, 6]
+        p = str(tmp_path / "e.arrow")
+        tio.write_arrow_ipc(df, p)
+        back = tio.read_arrow_ipc(p)
+        assert back.offsets == [0, 3, 3, 6]
+        np.testing.assert_array_equal(back.column("x").values, df.column("x").values)
+
+    def test_all_empty_frame_roundtrip(self, tmp_path):
+        from tensorframes_tpu import io as tio
+
+        df = TensorFrame.from_dict({"x": np.zeros((0,), dtype=np.float32)})
+        p = str(tmp_path / "z.arrow")
+        tio.write_arrow_ipc(df, p)
+        back = tio.read_arrow_ipc(p)
+        assert back.nrows == 0
+        assert back.column("x").values.dtype == np.float32
+
     def test_ragged_roundtrip(self, tmp_path):
         from tensorframes_tpu import io as tio
 

@@ -169,11 +169,16 @@ def map_blocks(
         )
 
     out_cols = [
-        Column(_base(f), _api._concat_parts(acc[_base(f)]))
+        Column(
+            _base(f),
+            _api._concat_parts(acc[_base(f)])
+            if acc[_base(f)]
+            else _api._empty_output(summary, _base(f), drop_lead=True),
+        )
         for f in fetch_list
     ]
     if trim:
-        offsets = list(np.cumsum([0] + block_sizes))
+        offsets = list(np.cumsum([0] + (block_sizes or [0])))
         return _api._output_frame(
             frame, out_cols, append_input=False, offsets=offsets
         )

@@ -1,0 +1,82 @@
+"""Ragged map_rows: shape-bucketed vmap vs the per-row dispatch loop.
+
+The reference handles variable-length rows with one session.run PER ROW
+(`performMapRows`, `DebugRowOps.scala:826-864`; `TFDataOps.scala:90-103`).
+Round 1 of this framework inherited that shape as a per-row jit dispatch
+loop; this benchmark pins the round-2 bucketed path's win over it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def _per_row_reference(df, cols, jrow):
+    """The round-1 per-row loop, kept here as the comparison baseline."""
+    out = []
+    for i in range(df.nrows):
+        cells = [np.asarray(df.column(c).row(i)) for c in cols]
+        out.append(np.asarray(jrow(*cells)[0]))
+    return out
+
+
+def main():
+    import jax
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import dsl
+    from tensorframes_tpu.ops.lowering import build_callable
+
+    n = scaled("RAGGED_ROWS", 100_000)
+    loop_n = scaled("RAGGED_LOOP_ROWS", min(n, 2_000))
+    rng = np.random.default_rng(0)
+    shapes = [(3,), (7,), (12,), (5,)]
+    cells = [rng.normal(size=shapes[i % len(shapes)]).astype(np.float32) for i in range(n)]
+    df = tfs.TensorFrame.from_dict({"v": cells})
+
+    v = tfs.row(df, "v")
+    s = dsl.reduce_sum(v, axes=[0]).named("s")
+
+    # bucketed path (warm-up compiles, then timed)
+    tfs.map_rows(s, df)
+    t0 = time.perf_counter()
+    out = tfs.map_rows(s, df)
+    t1 = time.perf_counter()
+    bucketed_rows_s = n / (t1 - t0)
+
+    # per-row loop baseline on a subset (it is ~1000x slower; extrapolate)
+    graph, fetches = dsl.build(s)
+    jrow = jax.jit(build_callable(graph, fetches, ["v"]))
+    sub = tfs.TensorFrame.from_dict({"v": cells[:loop_n]})
+    _per_row_reference(sub, ["v"], jrow)  # warm-up
+    t0 = time.perf_counter()
+    _per_row_reference(sub, ["v"], jrow)
+    t1 = time.perf_counter()
+    loop_rows_s = loop_n / (t1 - t0)
+
+    want = np.array([c.sum() for c in cells], dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(out["s"].values), want, rtol=1e-4, atol=1e-5
+    )
+
+    emit(
+        f"ragged map_rows bucketed ({n} rows, {len(shapes)} shapes)",
+        round(bucketed_rows_s),
+        "rows/s",
+    )
+    emit(
+        "ragged map_rows bucketed speedup vs per-row loop",
+        round(bucketed_rows_s / loop_rows_s, 1),
+        "x",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,8 @@ SMOKE_SIZES = {
     "INCEPTION_IMAGES": "16",
     "INCEPTION_SIZE": "32",
     "INCEPTION_WIDTH": "8",
+    "RAGGED_ROWS": "20000",
+    "RAGGED_LOOP_ROWS": "500",
 }
 
 
@@ -39,6 +41,7 @@ def main():
         "map_rows_mlp_bench",
         "aggregate_bench",
         "inception_bench",
+        "ragged_map_rows_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
 

@@ -1109,6 +1109,151 @@ def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
     return GroupedFrame(frame, keys)
 
 
+def _aggregate_chunked(
+    run: Callable,
+    feed_names: List[str],
+    col_data: Dict[str, np.ndarray],
+    counts: np.ndarray,
+    starts: np.ndarray,
+    num_groups: int,
+    bases: List[str],
+) -> Dict[str, np.ndarray]:
+    """Keyed aggregation by pow2 chunk decomposition + pairwise combine.
+
+    The exact plan (one vmapped call per distinct group size) compiles
+    O(#distinct sizes) programs — a pathological key distribution with
+    all-distinct sizes compiles one program per group. Here each sorted
+    group splits into power-of-two chunks (binary decomposition of its
+    size, in row order); all chunks of one size run as ONE vmapped call;
+    then per-group partials merge pairwise, all groups' pairs batched per
+    round. Compile count: O(log max_size) chunk programs + O(log log)
+    combine rounds, independent of the size distribution.
+
+    Requires the associativity the reduce contract already demands —
+    the reference's UDAF equally re-reduces partial buffers on overflow
+    (`TensorFlowUDAF.compact`, `DebugRowOps.scala:651-663`).
+
+    ``run(feeds)`` executes the vmapped graph on ``(n, size, *cell)``
+    feeds (mesh callers shard the lead axis). Lead dims arriving here are
+    already padded to powers of two; padding rows replicate real data and
+    their outputs are discarded.
+
+    Before the first combine round a re-feed probe runs: each fetch's
+    first partial is fed back through the graph as a 1-row block and must
+    reproduce itself. Graphs that transform rows before reducing (e.g.
+    ``Sum(x_input * x_input)``) fail the probe and raise instead of
+    silently mis-aggregating — they are equally wrong through multi-block
+    `reduce_blocks` and the reference's pairwise `RDD.reduce`.
+    """
+    # 1. binary chunk decomposition of every sorted group, in row order
+    chunk_starts_by_p: Dict[int, List[int]] = {}
+    chunk_ids_by_p: Dict[int, List[int]] = {}
+    group_partials: List[List[int]] = [[] for _ in range(num_groups)]
+    next_id = 0
+    for g in range(num_groups):
+        s = int(counts[g])
+        pos = int(starts[g])
+        while s:
+            p = 1 << (s.bit_length() - 1)
+            chunk_starts_by_p.setdefault(p, []).append(pos)
+            chunk_ids_by_p.setdefault(p, []).append(next_id)
+            group_partials[g].append(next_id)
+            next_id += 1
+            pos += p
+            s -= p
+
+    store: Dict[str, List[Optional[np.ndarray]]] = {
+        b: [None] * next_id for b in bases
+    }
+
+    # 2. chunk stage: one batched call per distinct pow2 chunk size
+    for p in sorted(chunk_starts_by_p, reverse=True):
+        starts_list = chunk_starts_by_p[p]
+        n_p = len(starts_list)
+        padded = 1 << (n_p - 1).bit_length()
+        st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
+        row_idx = st[:, None] + np.arange(p)[None, :]
+        feeds = [col_data[n][row_idx] for n in feed_names]
+        outs = run(feeds)
+        maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
+        for b, o in zip(bases, outs):
+            o = np.asarray(o)
+            ids = chunk_ids_by_p[p]
+            for j, cid in enumerate(ids):
+                store[b][cid] = o[j]
+
+    # re-feed probe: partials must survive a singleton re-application
+    # before any combine round may reuse the graph on them
+    if next_id and max(map(len, group_partials), default=0) > 1:
+        probe_feeds = [
+            store[n[: -len("_input")]][0][None, None] for n in feed_names
+        ]
+        probe_outs = run(probe_feeds)
+        for b, o in zip(bases, probe_outs):
+            got = np.asarray(o)[0]
+            want = store[b][0]
+            if not np.allclose(
+                got, want, rtol=1e-4, atol=1e-6, equal_nan=True
+            ):
+                raise ValueError(
+                    f"aggregate: fetch {b!r} is not re-feed stable "
+                    f"(graph(partial) != partial); the combine step re-feeds "
+                    "partials through the same graph, so the graph must be a "
+                    "pure associative reduction of its placeholder (no "
+                    "per-row transform before the reduce — precompute such "
+                    "columns with map_blocks first)"
+                )
+
+    # 3. combine rounds: pair adjacent partials of every group, batched
+    while max(map(len, group_partials), default=0) > 1:
+        left: List[int] = []
+        right: List[int] = []
+        new_lists: List[List] = []
+        for ids in group_partials:
+            out_ids: List = []
+            for i in range(0, len(ids) - 1, 2):
+                left.append(ids[i])
+                right.append(ids[i + 1])
+                out_ids.append(("new", len(left) - 1))
+            if len(ids) % 2:
+                out_ids.append(ids[-1])
+            new_lists.append(out_ids)
+        npairs = len(left)
+        padded = 1 << (npairs - 1).bit_length()
+        pad = padded - npairs
+        feeds = []
+        for n in feed_names:
+            b = n[: -len("_input")]
+            sb = store[b]
+            feeds.append(
+                np.stack(
+                    [
+                        np.stack((sb[l], sb[r]))
+                        for l, r in zip(
+                            left + left[:1] * pad, right + right[:1] * pad
+                        )
+                    ]
+                )
+            )
+        outs = run(feeds)
+        maybe_check_numerics(bases, outs, "aggregate combine round")
+        off = len(store[bases[0]])
+        for b, o in zip(bases, outs):
+            store[b].extend(np.asarray(o)[:npairs])
+        group_partials = [
+            [off + t[1] if isinstance(t, tuple) else t for t in ids]
+            for ids in new_lists
+        ]
+
+    # 4. gather final partial per group
+    if num_groups == 0:
+        return {}
+    return {
+        b: np.stack([store[b][ids[0]] for ids in group_partials])
+        for b in bases
+    }
+
+
 def aggregate(
     fetches: Fetches,
     grouped: GroupedFrame,
@@ -1165,24 +1310,44 @@ def aggregate(
     results: Dict[str, np.ndarray] = {}
     col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
 
-    out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
-    for size in np.unique(counts):
-        gids = np.nonzero(counts == size)[0]
-        if size == 0:
-            continue
-        row_idx = starts[gids][:, None] + np.arange(size)[None, :]
-        feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
-        outs = vraw(*feeds)
-        maybe_check_numerics(bases, outs, f"aggregate groups of size {size}")
-        for b, o in zip(bases, outs):
-            o = np.asarray(o)
-            if out_buffers[b] is None:
-                out_buffers[b] = np.zeros((num_groups,) + o.shape[1:], o.dtype)
-            out_buffers[b][gids] = o
-    for b in bases:
-        if out_buffers[b] is None:  # empty frame: zero groups
-            out_buffers[b] = _empty_output(summary, b, drop_lead=False)
-        results[b] = out_buffers[b]
+    from . import config as _config
+
+    unique_sizes = np.unique(counts[counts > 0])
+    if len(unique_sizes) <= _config.get().aggregate_exact_size_limit:
+        # exact plan: one vmapped call per distinct size, whole groups —
+        # no associativity assumption, best for regular key distributions
+        out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+        for size in unique_sizes:
+            gids = np.nonzero(counts == size)[0]
+            row_idx = starts[gids][:, None] + np.arange(size)[None, :]
+            feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
+            outs = vraw(*feeds)
+            maybe_check_numerics(bases, outs, f"aggregate groups of size {size}")
+            for b, o in zip(bases, outs):
+                o = np.asarray(o)
+                if out_buffers[b] is None:
+                    out_buffers[b] = np.zeros(
+                        (num_groups,) + o.shape[1:], o.dtype
+                    )
+                out_buffers[b][gids] = o
+        for b in bases:
+            if out_buffers[b] is None:  # empty frame: zero groups
+                out_buffers[b] = _empty_output(summary, b, drop_lead=False)
+            results[b] = out_buffers[b]
+    else:
+        # pathological size distributions: pow2 chunk decomposition keeps
+        # the compile count O(log max_size) instead of O(#distinct sizes)
+        results.update(
+            _aggregate_chunked(
+                lambda feeds: vraw(*feeds),
+                feed_names,
+                col_data,
+                counts,
+                starts,
+                num_groups,
+                bases,
+            )
+        )
 
     cols = [Column(k, v) for k, v in key_out.items()]
     cols += [Column(b, results[b]) for b in sorted(bases)]

@@ -376,14 +376,16 @@ def aggregate(
     of its placeholder): shard-local `segment_sum` into a dense
     (num_keys, ...) table + `psum` over ICI — two collectives total,
     replacing the reference's UDAF buffer/compact/shuffle machinery.
-    Non-sum graphs fall back to the host grouped path (`api.aggregate`),
-    which is still batched per group size.
+    Any other graph meeting the reduce contract runs the chunked
+    associative plan with its batched stages shard_mapped over the mesh
+    (`_aggregate_mesh_general`) — a re-feed probe rejects graphs that
+    transform rows before reducing.
     """
     frame = grouped.frame
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
     if not _all_fetches_are_lead_sums(graph, fetch_list):
-        return _api.aggregate(
-            graph, grouped, feed_dict, fetch_names=fetch_list
+        return _aggregate_mesh_general(
+            graph, grouped, mesh, feed_dict, fetch_list, executor
         )
     overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
@@ -413,7 +415,10 @@ def aggregate(
         return tuple(outs)
 
     results: Dict[str, np.ndarray] = {}
-    bases = [_base(f) for f in fetch_list]
+    # seg_psum returns one output per FEED (sorted feed_names order); the
+    # base receiving each output is the feed's x_input -> x pairing, NOT
+    # fetch_list order (they differ with several fetches)
+    bases = [n[: -len("_input")] for n in feed_names]
     main_cols = [frame.column(c).values[: s * ndev] for c in cols_used]
     tail_cols = [frame.column(c).values[s * ndev :] for c in cols_used]
     acc = [np.zeros(0)] * len(bases)
@@ -441,6 +446,89 @@ def aggregate(
     for b, a in zip(bases, acc):
         results[b] = a
 
+    cols = [Column(k, v) for k, v in key_out.items()]
+    cols += [Column(b, results[b]) for b in sorted(bases)]
+    return TensorFrame(cols)
+
+
+def _aggregate_mesh_general(
+    graph: Graph,
+    grouped: "_api.GroupedFrame",
+    mesh: Mesh,
+    feed_dict: Optional[Dict[str, str]],
+    fetch_list: List[str],
+    executor: Optional[Executor],
+) -> TensorFrame:
+    """Mesh aggregation for ANY graph meeting the reduce contract.
+
+    Round 1 only meshed `Sum(x_input, axis=0)` graphs and silently fell
+    back to the host path for everything else. Here the pow2
+    chunk-decomposition plan (`api._aggregate_chunked`) runs with its
+    heavy stages sharded: every batched call — all same-size chunks, all
+    pairwise combines of a round — is `shard_map`ped over the chunk axis
+    of the mesh's ``data`` dimension, so per-chunk reductions execute
+    devices-wide with zero collectives (chunks are independent; only the
+    tiny final gather is host-side). Associativity is the same contract
+    `reduce_blocks`' combine step already demands — and the reference's
+    own UDAF compaction requires (`DebugRowOps.scala:651-663`).
+    """
+    ex = executor or default_executor()
+    frame = grouped.frame
+    overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    _api._validate_reduce_blocks(summary, fetch_list)
+    mapping = _api._match_columns(summary, frame, feed_dict, block_level=True)
+    _api._require_dense(frame, list(mapping.values()), "aggregate")
+
+    from ..frame import factorize_keys
+
+    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
+    num_groups = len(next(iter(key_out.values())))
+    order = np.argsort(inverse, kind="stable")
+    counts = np.bincount(inverse, minlength=num_groups)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+
+    feed_names = sorted(summary.inputs)
+    bases = [_base(f) for f in fetch_list]
+    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
+
+    vfn = jax.vmap(build_callable(graph, fetch_list, feed_names))
+    local = ex.cached(
+        "vmap-agg", graph, fetch_list, feed_names, lambda: jax.jit(vfn)
+    )
+    ndev = mesh.devices.size
+    # chunk feeds are (n, size, *cell) for every stage, so ONE shard_map
+    # over the lead (chunk) axis serves both the chunk and combine stages
+    sharded = ex.cached(
+        f"shagg-{ndev}",
+        graph,
+        fetch_list,
+        feed_names,
+        lambda: jax.jit(
+            shard_map(
+                vfn,
+                mesh=mesh,
+                in_specs=tuple(P("data") for _ in feed_names),
+                out_specs=tuple(P("data") for _ in fetch_list),
+                check_vma=False,
+            )
+        ),
+    )
+
+    def run(feeds):
+        lead = feeds[0].shape[0]
+        if lead >= ndev and lead % ndev == 0:
+            return sharded(*feeds)
+        return local(*feeds)
+
+    results = _api._aggregate_chunked(
+        run, feed_names, col_data, counts, starts, num_groups, bases
+    )
+    if num_groups == 0:  # empty frame: zero-row outputs from analysis
+        results = {
+            b: _api._empty_output(summary, b, drop_lead=False) for b in bases
+        }
     cols = [Column(k, v) for k, v in key_out.items()]
     cols += [Column(b, results[b]) for b in sorted(bases)]
     return TensorFrame(cols)

@@ -697,6 +697,83 @@ class TestAllEmptyFrames:
         assert out.column("z").values.shape == (0, 2)
 
 
+class TestAggregateChunked:
+    """Pow2 chunk decomposition for pathological group-size distributions:
+    compiles stay O(log max_size) where round 1 compiled one program per
+    distinct size (api.py round-1 weakness #4)."""
+
+    def _frame(self, sizes, seed=0):
+        rng = np.random.default_rng(seed)
+        keys = np.repeat(np.arange(len(sizes), dtype=np.int64), sizes)
+        x = rng.normal(size=keys.shape[0])
+        return frame_of(k=keys, x=x)
+
+    def _sum_graph(self, df):
+        return dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+
+    def test_matches_exact_path(self):
+        from tensorframes_tpu import config
+
+        sizes = [1, 2, 3, 5, 8, 13, 21, 1, 7]
+        df = self._frame(sizes)
+        s = self._sum_graph(df)
+        exact = tfs.aggregate(s, tfs.group_by(df, "k")).to_pandas()
+        with config.override(aggregate_exact_size_limit=1):
+            chunked = tfs.aggregate(s, tfs.group_by(df, "k")).to_pandas()
+        exact = exact.sort_values("k").reset_index(drop=True)
+        chunked = chunked.sort_values("k").reset_index(drop=True)
+        np.testing.assert_allclose(chunked["x"], exact["x"], rtol=1e-12)
+
+    def test_min_graph_chunked(self):
+        from tensorframes_tpu import config
+
+        sizes = [3, 1, 4, 1, 5, 9, 2, 6]
+        df = self._frame(sizes)
+        m = dsl.reduce_min(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with config.override(aggregate_exact_size_limit=1):
+            out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        want = [x[k == g].min() for g in range(len(sizes))]
+        np.testing.assert_allclose(out["x"], want)
+
+    def test_refeed_unstable_graph_rejected(self):
+        # Sum(x_input * x_input) reduces a TRANSFORM of its rows: the
+        # combine step would square partials again, so the probe raises
+        from tensorframes_tpu import config
+
+        df = self._frame([3, 5])
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        bad = dsl.reduce_sum(x_input * x_input, axes=[0]).named("x")
+        with config.override(aggregate_exact_size_limit=1):
+            with pytest.raises(ValueError, match="re-feed"):
+                tfs.aggregate(bad, tfs.group_by(df, "k"))
+
+    def test_compile_count_bounded_many_distinct_sizes(self):
+        from tensorframes_tpu.runtime.executor import Executor
+
+        # 400 groups, every size distinct (1..400): the exact plan would
+        # compile 400 programs; the chunked plan must stay ~O(log 400)
+        sizes = np.arange(1, 401)
+        df = self._frame(sizes)
+        s = self._sum_graph(df)
+        ex = Executor()
+        out = tfs.aggregate(s, tfs.group_by(df, "k"), executor=ex)
+        (vraw,) = ex._cache.values()
+        assert vraw._cache_size() <= 20, vraw._cache_size()
+        # correctness at scale
+        odf = out.to_pandas().sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        want = np.array([x[k == g].sum() for g in range(400)])
+        np.testing.assert_allclose(odf["x"], want, rtol=1e-9)
+
+
 class TestMultiKeyAggregate:
     """groupBy over several key columns (the reference's
     `df.groupBy(k1, k2).agg`, reachable through `RelationalGroupedDataset`)."""

@@ -1109,6 +1109,119 @@ def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
     return GroupedFrame(frame, keys)
 
 
+def _group_plan(
+    grouped: GroupedFrame,
+    mapping: Dict[str, str],
+    feed_names: List[str],
+):
+    """Shared keyed-aggregation prologue: factorize keys, sort rows by
+    group, gather sorted feed columns. Returns
+    ``(key_out, num_groups, counts, starts, col_data)`` — the one copy of
+    the Catalyst-shuffle analogue both the host and mesh paths use."""
+    frame = grouped.frame
+    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
+    num_groups = len(next(iter(key_out.values())))
+    order = np.argsort(inverse, kind="stable")
+    counts = np.bincount(inverse, minlength=num_groups)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
+    return key_out, num_groups, counts, starts, col_data
+
+
+def _keyed_output(
+    key_out: Dict[str, np.ndarray],
+    results: Dict[str, np.ndarray],
+    bases: List[str],
+) -> TensorFrame:
+    """Key columns + sorted output columns (`DebugRowOps.scala:583-598`)."""
+    cols = [Column(k, v) for k, v in key_out.items()]
+    cols += [Column(b, results[b]) for b in sorted(bases)]
+    return TensorFrame(cols)
+
+
+# Reduce roots the chunked plan can combine, and their partial combiners.
+_CHUNK_COMBINERS = {
+    "Sum": "sum",
+    "Min": "min",
+    "Max": "max",
+    "Prod": "prod",
+    "Mean": "mean",
+}
+
+# Ops that act row-locally (each output row depends only on the matching
+# input row and on sub-lead-rank constants) — safe between a placeholder
+# and the root reduce under chunking.
+_ROWWISE_OPS = {
+    "Identity", "StopGradient", "PreventGradient", "CheckNumerics",
+    "Snapshot", "Cast",
+    "Abs", "Neg", "Exp", "Log", "Log1p", "Sqrt", "Rsqrt", "Square",
+    "Sign", "Floor", "Ceil", "Round", "Relu", "Relu6", "Elu", "Selu",
+    "Softplus", "Softsign", "Sigmoid", "Tanh", "Sin", "Cos", "Tan",
+    "Erf", "Reciprocal",
+    "Add", "AddV2", "Sub", "Mul", "Div", "RealDiv", "TruncateDiv",
+    "FloorDiv", "Maximum", "Minimum", "Pow", "SquaredDifference", "Mod",
+    "FloorMod",
+}
+
+
+def _chunk_combiners(
+    graph: Graph, fetch_list: List[str], summary: GraphSummary
+) -> Optional[Dict[str, str]]:
+    """Classify each fetch as ``Reduce(rowwise(placeholder), axis=0)``.
+
+    Returns base -> combiner tag when EVERY fetch is a recognized monoid
+    reduce over the lead axis of a row-local transform of its
+    placeholder — the class the chunked plan computes exactly (chunk
+    partials combine with the derived monoid, size-weighted for Mean).
+    Returns None otherwise; callers then use the exact whole-group plan.
+    Structural, so transform-then-reduce graphs like ``Sum(x*x)`` chunk
+    correctly and unclassifiable graphs are never silently wrong.
+    """
+    out: Dict[str, str] = {}
+    for f in fetch_list:
+        try:
+            node = graph[_base(f)]
+        except KeyError:
+            return None
+        if node.op not in _CHUNK_COMBINERS:
+            return None
+        if bool(node.attr("keep_dims", node.attr("keepdims", False))):
+            return None
+        if (
+            node.op == "Mean"
+            and not summary.outputs[_base(f)].dtype.is_floating
+        ):
+            # integer Mean truncates per chunk (TF semantics: div of sum
+            # by count), so truncated partials cannot recombine exactly
+            return None
+        data_in = node.data_inputs()
+        if len(data_in) != 2:
+            return None
+        idx_node = graph[data_in[1][0]]
+        if idx_node.op != "Const":
+            return None
+        axes = idx_node.attrs["value"].value.to_numpy().ravel().tolist()
+        if axes != [0]:
+            return None
+        # walk the transform subgraph: placeholder/const leaves, rowwise ops
+        seen = set()
+        stack = [data_in[0][0]]
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            n = graph[name]
+            if n.op in ("Placeholder", "PlaceholderV2", "Const"):
+                continue
+            if n.op not in _ROWWISE_OPS:
+                return None
+            stack.extend(src for src, _ in n.data_inputs())
+        out[_base(f)] = _CHUNK_COMBINERS[node.op]
+    return out
+
+
 def _aggregate_chunked(
     run: Callable,
     feed_names: List[str],
@@ -1117,141 +1230,103 @@ def _aggregate_chunked(
     starts: np.ndarray,
     num_groups: int,
     bases: List[str],
+    combiners: Dict[str, str],
+    pad_quantum: int = 1,
 ) -> Dict[str, np.ndarray]:
-    """Keyed aggregation by pow2 chunk decomposition + pairwise combine.
+    """Keyed aggregation by pow2 chunk decomposition + monoid combine.
 
     The exact plan (one vmapped call per distinct group size) compiles
     O(#distinct sizes) programs — a pathological key distribution with
     all-distinct sizes compiles one program per group. Here each sorted
     group splits into power-of-two chunks (binary decomposition of its
-    size, in row order); all chunks of one size run as ONE vmapped call;
-    then per-group partials merge pairwise, all groups' pairs batched per
-    round. Compile count: O(log max_size) chunk programs + O(log log)
-    combine rounds, independent of the size distribution.
-
-    Requires the associativity the reduce contract already demands —
-    the reference's UDAF equally re-reduces partial buffers on overflow
-    (`TensorFlowUDAF.compact`, `DebugRowOps.scala:651-663`).
+    size, in row order); all chunks of one size run as ONE vmapped call
+    of the FULL graph (per-row transforms apply inside the chunk); then
+    each group's partials combine with the fetch's derived monoid — one
+    `np.ufunc.reduceat` over all groups per fetch, size-weighted for
+    Mean. Compile count: O(log max_size), independent of the size
+    distribution. Only graphs classified by `_chunk_combiners` reach
+    this plan, so results are exact, not merely associativity-approximate.
 
     ``run(feeds)`` executes the vmapped graph on ``(n, size, *cell)``
-    feeds (mesh callers shard the lead axis). Lead dims arriving here are
-    already padded to powers of two; padding rows replicate real data and
-    their outputs are discarded.
-
-    Before the first combine round a re-feed probe runs: each fetch's
-    first partial is fed back through the graph as a 1-row block and must
-    reproduce itself. Graphs that transform rows before reducing (e.g.
-    ``Sum(x_input * x_input)``) fail the probe and raise instead of
-    silently mis-aggregating — they are equally wrong through multi-block
-    `reduce_blocks` and the reference's pairwise `RDD.reduce`.
+    feeds; lead dims are padded to ``pad_quantum * 2**k`` (mesh callers
+    pass the device count so every batched call shards evenly; padding
+    rows replicate real data and their outputs are discarded).
     """
+    if num_groups == 0:
+        return {}
     # 1. binary chunk decomposition of every sorted group, in row order
     chunk_starts_by_p: Dict[int, List[int]] = {}
-    chunk_ids_by_p: Dict[int, List[int]] = {}
-    group_partials: List[List[int]] = [[] for _ in range(num_groups)]
-    next_id = 0
+    chunk_slots_by_p: Dict[int, List[int]] = {}
+    chunk_sizes: List[int] = []  # per global chunk slot, in group order
+    group_nchunks = np.zeros(num_groups, dtype=np.int64)
+    next_slot = 0
     for g in range(num_groups):
         s = int(counts[g])
         pos = int(starts[g])
         while s:
             p = 1 << (s.bit_length() - 1)
             chunk_starts_by_p.setdefault(p, []).append(pos)
-            chunk_ids_by_p.setdefault(p, []).append(next_id)
-            group_partials[g].append(next_id)
-            next_id += 1
+            chunk_slots_by_p.setdefault(p, []).append(next_slot)
+            chunk_sizes.append(p)
+            group_nchunks[g] += 1
+            next_slot += 1
             pos += p
             s -= p
 
-    store: Dict[str, List[Optional[np.ndarray]]] = {
-        b: [None] * next_id for b in bases
-    }
+    def _padded(n: int) -> int:
+        q = pad_quantum
+        while q < n:
+            q *= 2
+        return q
 
-    # 2. chunk stage: one batched call per distinct pow2 chunk size
+    # 2. chunk stage: one batched call per distinct pow2 chunk size;
+    #    results land in a flat per-fetch partial table (group order)
+    partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
     for p in sorted(chunk_starts_by_p, reverse=True):
         starts_list = chunk_starts_by_p[p]
         n_p = len(starts_list)
-        padded = 1 << (n_p - 1).bit_length()
+        padded = _padded(n_p)
         st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
         row_idx = st[:, None] + np.arange(p)[None, :]
         feeds = [col_data[n][row_idx] for n in feed_names]
         outs = run(feeds)
         maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
+        slots = np.asarray(chunk_slots_by_p[p])
         for b, o in zip(bases, outs):
             o = np.asarray(o)
-            ids = chunk_ids_by_p[p]
-            for j, cid in enumerate(ids):
-                store[b][cid] = o[j]
-
-    # re-feed probe: partials must survive a singleton re-application
-    # before any combine round may reuse the graph on them
-    if next_id and max(map(len, group_partials), default=0) > 1:
-        probe_feeds = [
-            store[n[: -len("_input")]][0][None, None] for n in feed_names
-        ]
-        probe_outs = run(probe_feeds)
-        for b, o in zip(bases, probe_outs):
-            got = np.asarray(o)[0]
-            want = store[b][0]
-            if not np.allclose(
-                got, want, rtol=1e-4, atol=1e-6, equal_nan=True
-            ):
-                raise ValueError(
-                    f"aggregate: fetch {b!r} is not re-feed stable "
-                    f"(graph(partial) != partial); the combine step re-feeds "
-                    "partials through the same graph, so the graph must be a "
-                    "pure associative reduction of its placeholder (no "
-                    "per-row transform before the reduce — precompute such "
-                    "columns with map_blocks first)"
+            if partials[b] is None:
+                partials[b] = np.empty(
+                    (next_slot,) + o.shape[1:], dtype=o.dtype
                 )
+            partials[b][slots] = o[:n_p]
 
-    # 3. combine rounds: pair adjacent partials of every group, batched
-    while max(map(len, group_partials), default=0) > 1:
-        left: List[int] = []
-        right: List[int] = []
-        new_lists: List[List] = []
-        for ids in group_partials:
-            out_ids: List = []
-            for i in range(0, len(ids) - 1, 2):
-                left.append(ids[i])
-                right.append(ids[i + 1])
-                out_ids.append(("new", len(left) - 1))
-            if len(ids) % 2:
-                out_ids.append(ids[-1])
-            new_lists.append(out_ids)
-        npairs = len(left)
-        padded = 1 << (npairs - 1).bit_length()
-        pad = padded - npairs
-        feeds = []
-        for n in feed_names:
-            b = n[: -len("_input")]
-            sb = store[b]
-            feeds.append(
-                np.stack(
-                    [
-                        np.stack((sb[l], sb[r]))
-                        for l, r in zip(
-                            left + left[:1] * pad, right + right[:1] * pad
-                        )
-                    ]
-                )
-            )
-        outs = run(feeds)
-        maybe_check_numerics(bases, outs, "aggregate combine round")
-        off = len(store[bases[0]])
-        for b, o in zip(bases, outs):
-            store[b].extend(np.asarray(o)[:npairs])
-        group_partials = [
-            [off + t[1] if isinstance(t, tuple) else t for t in ids]
-            for ids in new_lists
-        ]
-
-    # 4. gather final partial per group
-    if num_groups == 0:
-        return {}
-    return {
-        b: np.stack([store[b][ids[0]] for ids in group_partials])
-        for b in bases
-    }
+    # 3. combine: one reduceat per fetch over the flat partial tables
+    bounds = np.concatenate(
+        [[0], np.cumsum(group_nchunks)[:-1]]
+    ).astype(np.int64)
+    sizes = np.asarray(chunk_sizes, dtype=np.float64)
+    results: Dict[str, np.ndarray] = {}
+    for b in bases:
+        tab = partials[b]
+        comb = combiners[b]
+        if comb == "sum":
+            results[b] = np.add.reduceat(tab, bounds, axis=0)
+        elif comb == "min":
+            results[b] = np.minimum.reduceat(tab, bounds, axis=0)
+        elif comb == "max":
+            results[b] = np.maximum.reduceat(tab, bounds, axis=0)
+        elif comb == "prod":
+            results[b] = np.multiply.reduceat(tab, bounds, axis=0)
+        elif comb == "mean":
+            w = sizes.reshape((-1,) + (1,) * (tab.ndim - 1))
+            num = np.add.reduceat(tab * w, bounds, axis=0)
+            den = np.add.reduceat(sizes, bounds)
+            results[b] = (
+                num / den.reshape((-1,) + (1,) * (tab.ndim - 1))
+            ).astype(tab.dtype)
+        else:  # pragma: no cover - classifier emits only the tags above
+            raise AssertionError(f"unknown combiner {comb!r}")
+    return results
 
 
 def aggregate(
@@ -1286,16 +1361,10 @@ def aggregate(
     mapping = _match_columns(summary, frame, feed_dict, block_level=True)
     _require_dense(frame, list(mapping.values()), "aggregate")
 
-    # --- factorize keys (host; the Catalyst shuffle analogue) ----------
-    key_arrays = [frame.column(k).values for k in grouped.keys]
-    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
-    num_groups = len(next(iter(key_out.values())))
-    order = np.argsort(inverse, kind="stable")
-    sorted_gid = inverse[order]
-    counts = np.bincount(inverse, minlength=num_groups)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-
     feed_names = sorted(summary.inputs)
+    key_out, num_groups, counts, starts, col_data = _group_plan(
+        grouped, mapping, feed_names
+    )
     vraw = ex.cached(
         "vmap-agg",
         graph,
@@ -1308,12 +1377,16 @@ def aggregate(
 
     bases = [_base(f) for f in fetch_list]
     results: Dict[str, np.ndarray] = {}
-    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
 
     from . import config as _config
 
     unique_sizes = np.unique(counts[counts > 0])
-    if len(unique_sizes) <= _config.get().aggregate_exact_size_limit:
+    combiners = None
+    if len(unique_sizes) > _config.get().aggregate_exact_size_limit:
+        # only chunk when the graph is provably chunk-safe; otherwise the
+        # exact plan keeps correctness at the cost of more compiles
+        combiners = _chunk_combiners(graph, fetch_list, summary)
+    if combiners is None:
         # exact plan: one vmapped call per distinct size, whole groups —
         # no associativity assumption, best for regular key distributions
         out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
@@ -1346,12 +1419,11 @@ def aggregate(
                 starts,
                 num_groups,
                 bases,
+                combiners,
             )
         )
 
-    cols = [Column(k, v) for k, v in key_out.items()]
-    cols += [Column(b, results[b]) for b in sorted(bases)]
-    return TensorFrame(cols)
+    return _keyed_output(key_out, results, bases)
 
 
 # ---------------------------------------------------------------------------

@@ -40,12 +40,13 @@ class Config:
     default_mesh: Optional[object] = None
     compilation_cache_dir: Optional[str] = None
     aggregate_buffer_rows: int = 10
-    # aggregate: above this many DISTINCT group sizes, switch from the
-    # exact one-vmap-per-size plan to pow2 chunk decomposition + pairwise
-    # combine (compiles O(log max_size) instead of O(#distinct sizes);
-    # requires the associativity the reduce contract already demands —
-    # the reference's UDAF likewise re-reduces partial buffers,
-    # `TensorFlowUDAF.compact`, DebugRowOps.scala:651-663).
+    # aggregate: above this many DISTINCT group sizes, graphs classified
+    # as Reduce(rowwise(placeholder), axis=0) (api._chunk_combiners:
+    # Sum/Min/Max/Prod, float Mean) switch from the exact
+    # one-vmap-per-size plan to pow2 chunk decomposition with a
+    # derived-monoid combine — compiles O(log max_size) instead of
+    # O(#distinct sizes). Unclassifiable graphs always stay on the exact
+    # plan (correct, but compile-heavy under pathological distributions).
     aggregate_exact_size_limit: int = 32
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0

@@ -376,10 +376,10 @@ def aggregate(
     of its placeholder): shard-local `segment_sum` into a dense
     (num_keys, ...) table + `psum` over ICI — two collectives total,
     replacing the reference's UDAF buffer/compact/shuffle machinery.
-    Any other graph meeting the reduce contract runs the chunked
-    associative plan with its batched stages shard_mapped over the mesh
-    (`_aggregate_mesh_general`) — a re-feed probe rejects graphs that
-    transform rows before reducing.
+    Other graphs classified as `Reduce(rowwise(placeholder), axis=0)`
+    run the chunked plan with the chunk stage shard_mapped over the mesh
+    (`_aggregate_mesh_general`); anything else falls back to the host
+    exact plan.
     """
     frame = grouped.frame
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
@@ -459,39 +459,40 @@ def _aggregate_mesh_general(
     fetch_list: List[str],
     executor: Optional[Executor],
 ) -> TensorFrame:
-    """Mesh aggregation for ANY graph meeting the reduce contract.
+    """Mesh aggregation for any chunk-safe graph (`api._chunk_combiners`).
 
     Round 1 only meshed `Sum(x_input, axis=0)` graphs and silently fell
-    back to the host path for everything else. Here the pow2
-    chunk-decomposition plan (`api._aggregate_chunked`) runs with its
-    heavy stages sharded: every batched call — all same-size chunks, all
-    pairwise combines of a round — is `shard_map`ped over the chunk axis
-    of the mesh's ``data`` dimension, so per-chunk reductions execute
-    devices-wide with zero collectives (chunks are independent; only the
-    tiny final gather is host-side). Associativity is the same contract
-    `reduce_blocks`' combine step already demands — and the reference's
-    own UDAF compaction requires (`DebugRowOps.scala:651-663`).
+    back to the host path for everything else. Here every fetch
+    classified as `Reduce(rowwise(placeholder), axis=0)` — Min/Max/Mean/
+    Prod/Sum over arbitrary row-local transforms — runs the pow2
+    chunk-decomposition plan (`api._aggregate_chunked`) with the chunk
+    stage `shard_map`ped over the mesh's ``data`` axis: per-chunk
+    reductions execute devices-wide with zero collectives (chunks are
+    independent), and partials combine host-side with the DERIVED monoid
+    (size-weighted for Mean), so results are exact. Unclassifiable
+    graphs fall back to the host exact plan rather than risking a wrong
+    partial-combine — the correctness-first choice the reference makes
+    with its driver-funneled reduce.
     """
     ex = executor or default_executor()
     frame = grouped.frame
     overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    combiners = _api._chunk_combiners(graph, fetch_list, summary)
+    if combiners is None:
+        return _api.aggregate(
+            graph, grouped, feed_dict, fetch_names=fetch_list,
+            executor=executor,
+        )
     _api._validate_reduce_blocks(summary, fetch_list)
     mapping = _api._match_columns(summary, frame, feed_dict, block_level=True)
     _api._require_dense(frame, list(mapping.values()), "aggregate")
 
-    from ..frame import factorize_keys
-
-    key_arrays = [frame.column(k).values for k in grouped.keys]
-    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
-    num_groups = len(next(iter(key_out.values())))
-    order = np.argsort(inverse, kind="stable")
-    counts = np.bincount(inverse, minlength=num_groups)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-
     feed_names = sorted(summary.inputs)
     bases = [_base(f) for f in fetch_list]
-    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
+    key_out, num_groups, counts, starts, col_data = _api._group_plan(
+        grouped, mapping, feed_names
+    )
 
     vfn = jax.vmap(build_callable(graph, fetch_list, feed_names))
     local = ex.cached(
@@ -517,21 +518,29 @@ def _aggregate_mesh_general(
     )
 
     def run(feeds):
+        # pad_quantum=ndev makes every chunk-stage lead ndev * 2^k, so
+        # this always shards on any device count, pow2 or not
         lead = feeds[0].shape[0]
         if lead >= ndev and lead % ndev == 0:
             return sharded(*feeds)
         return local(*feeds)
 
     results = _api._aggregate_chunked(
-        run, feed_names, col_data, counts, starts, num_groups, bases
+        run,
+        feed_names,
+        col_data,
+        counts,
+        starts,
+        num_groups,
+        bases,
+        combiners,
+        pad_quantum=ndev,
     )
     if num_groups == 0:  # empty frame: zero-row outputs from analysis
         results = {
             b: _api._empty_output(summary, b, drop_lead=False) for b in bases
         }
-    cols = [Column(k, v) for k, v in key_out.items()]
-    cols += [Column(b, results[b]) for b in sorted(bases)]
-    return TensorFrame(cols)
+    return _api._keyed_output(key_out, results, bases)
 
 
 def _all_fetches_are_lead_sums(graph: Graph, fetch_list: List[str]) -> bool:

@@ -166,6 +166,21 @@ class TestDistributedAggregate:
             np.testing.assert_allclose(mean, sel.mean(), rtol=1e-9)
             np.testing.assert_allclose(var, sel.var(), rtol=1e-8)
 
+    def test_mesh_mean_of_transform(self, mesh):
+        # Mean(2x+1) over the mesh: rowwise transform + size-weighted
+        # monoid combine, exact against numpy
+        rng = np.random.default_rng(6)
+        keys = rng.integers(0, 11, size=1000).astype(np.int64)
+        vals = rng.normal(size=1000)
+        df = tfs.TensorFrame.from_dict({"key": keys, "x": vals})
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        m = dsl.reduce_mean(x_input * 2.0 + 1.0, axes=[0]).named("x")
+        out = tfs.aggregate(m, tfs.group_by(df, "key"), mesh=mesh)
+        for k, v in zip(out["key"].values, out["x"].values):
+            np.testing.assert_allclose(
+                v, (vals[keys == k] * 2.0 + 1.0).mean(), rtol=1e-9
+            )
+
     def test_mesh_min_aggregate_empty_frame(self, mesh):
         df = tfs.TensorFrame.from_dict(
             {

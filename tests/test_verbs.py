@@ -742,17 +742,74 @@ class TestAggregateChunked:
         want = [x[k == g].min() for g in range(len(sizes))]
         np.testing.assert_allclose(out["x"], want)
 
-    def test_refeed_unstable_graph_rejected(self):
+    def test_transform_then_reduce_chunked_exact(self):
         # Sum(x_input * x_input) reduces a TRANSFORM of its rows: the
-        # combine step would square partials again, so the probe raises
+        # chunk stage applies the transform per row, and the combine uses
+        # the DERIVED monoid (add), so chunking stays exact
+        from tensorframes_tpu import config
+
+        df = self._frame([3, 5, 7, 2])
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        ssq = dsl.reduce_sum(x_input * x_input, axes=[0]).named("x")
+        with config.override(aggregate_exact_size_limit=1):
+            out = tfs.aggregate(ssq, tfs.group_by(df, "k")).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        want = [(x[k == g] ** 2).sum() for g in range(4)]
+        np.testing.assert_allclose(out["x"], want, rtol=1e-12)
+
+    def test_mean_chunked_size_weighted(self):
+        # Mean partials combine size-weighted: a naive partial re-feed
+        # would average unequal chunks equally and be silently wrong
+        from tensorframes_tpu import config
+
+        sizes = [3, 5, 6, 7, 1]  # non-pow2 sizes force multi-chunk groups
+        df = self._frame(sizes)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        m = dsl.reduce_mean(x_input, axes=[0]).named("x")
+        with config.override(aggregate_exact_size_limit=1):
+            out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        want = [x[k == g].mean() for g in range(len(sizes))]
+        np.testing.assert_allclose(out["x"], want, rtol=1e-12)
+
+    def test_integer_mean_uses_exact_plan(self):
+        # integer Mean truncates per chunk, so the classifier refuses it
+        # and the exact plan computes TF's truncated whole-group mean
+        from tensorframes_tpu import config
+
+        keys = np.array([0, 0, 0, 1, 1], dtype=np.int64)
+        vals = np.array([0, 1, 5, 7, 2], dtype=np.int64)
+        df = frame_of(k=keys, x=vals)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        m = dsl.reduce_mean(x_input, axes=[0]).named("x")
+        with config.override(aggregate_exact_size_limit=0):
+            out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        assert out["x"].tolist() == [2, 4]  # 6//3, 9//2 — not 1.67/4.5
+
+    def test_unclassifiable_graph_uses_exact_plan(self):
+        # fetch = Min(x) - but wrapped so the root is not a recognized
+        # reduce: falls back to the exact whole-group plan (correct,
+        # never silently chunk-combined)
         from tensorframes_tpu import config
 
         df = self._frame([3, 5])
         x_input = tfs.block(df, "x", tf_name="x_input")
-        bad = dsl.reduce_sum(x_input * x_input, axes=[0]).named("x")
+        wrapped = dsl.identity(
+            dsl.reduce_min(x_input, axes=[0])
+        ).named("x")
         with config.override(aggregate_exact_size_limit=1):
-            with pytest.raises(ValueError, match="re-feed"):
-                tfs.aggregate(bad, tfs.group_by(df, "k"))
+            out = tfs.aggregate(wrapped, tfs.group_by(df, "k")).to_pandas()
+        out = out.sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        np.testing.assert_allclose(
+            out["x"], [x[k == g].min() for g in range(2)]
+        )
 
     def test_compile_count_bounded_many_distinct_sizes(self):
         from tensorframes_tpu.runtime.executor import Executor

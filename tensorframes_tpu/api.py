@@ -1222,6 +1222,36 @@ def _chunk_combiners(
     return out
 
 
+def _monoid_combine(
+    tab: np.ndarray,
+    bounds: np.ndarray,
+    comb: str,
+    weights: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Combine partial-reduce segments with a derived monoid: one ufunc
+    reduceat over a flat partial table (segments delimited by ``bounds``).
+    ``weights`` (contributing row counts per partial) is required for
+    the size-weighted ``mean`` combine."""
+    if comb == "sum":
+        return np.add.reduceat(tab, bounds, axis=0)
+    if comb == "min":
+        return np.minimum.reduceat(tab, bounds, axis=0)
+    if comb == "max":
+        return np.maximum.reduceat(tab, bounds, axis=0)
+    if comb == "prod":
+        return np.multiply.reduceat(tab, bounds, axis=0)
+    if comb == "mean":
+        if weights is None:
+            raise ValueError("mean combine needs partial weights")
+        w = weights.reshape((-1,) + (1,) * (tab.ndim - 1))
+        num = np.add.reduceat(tab * w, bounds, axis=0)
+        den = np.add.reduceat(weights, bounds)
+        return (num / den.reshape((-1,) + (1,) * (tab.ndim - 1))).astype(
+            tab.dtype
+        )
+    raise AssertionError(f"unknown combiner {comb!r}")
+
+
 def _aggregate_chunked(
     run: Callable,
     feed_names: List[str],
@@ -1305,28 +1335,10 @@ def _aggregate_chunked(
         [[0], np.cumsum(group_nchunks)[:-1]]
     ).astype(np.int64)
     sizes = np.asarray(chunk_sizes, dtype=np.float64)
-    results: Dict[str, np.ndarray] = {}
-    for b in bases:
-        tab = partials[b]
-        comb = combiners[b]
-        if comb == "sum":
-            results[b] = np.add.reduceat(tab, bounds, axis=0)
-        elif comb == "min":
-            results[b] = np.minimum.reduceat(tab, bounds, axis=0)
-        elif comb == "max":
-            results[b] = np.maximum.reduceat(tab, bounds, axis=0)
-        elif comb == "prod":
-            results[b] = np.multiply.reduceat(tab, bounds, axis=0)
-        elif comb == "mean":
-            w = sizes.reshape((-1,) + (1,) * (tab.ndim - 1))
-            num = np.add.reduceat(tab * w, bounds, axis=0)
-            den = np.add.reduceat(sizes, bounds)
-            results[b] = (
-                num / den.reshape((-1,) + (1,) * (tab.ndim - 1))
-            ).astype(tab.dtype)
-        else:  # pragma: no cover - classifier emits only the tags above
-            raise AssertionError(f"unknown combiner {comb!r}")
-    return results
+    return {
+        b: _monoid_combine(partials[b], bounds, combiners[b], weights=sizes)
+        for b in bases
+    }
 
 
 def aggregate(

@@ -28,6 +28,8 @@ __all__ = [
     "initialize_distributed",
     "global_data_mesh",
     "host_local_frame_to_global",
+    "analyze_global",
+    "aggregate_global",
 ]
 
 
@@ -112,6 +114,167 @@ def global_data_mesh(axes: Sequence[str] = ("data",)) -> Mesh:
     if len(axes) == 1:
         return Mesh(devices, tuple(axes))
     raise ValueError("use parallel.mesh_2d for multi-axis meshes")
+
+
+def analyze_global(frame: TensorFrame) -> TensorFrame:
+    """Distributed `analyze`: every process scans ITS local rows, then
+    the per-column shapes merge across all processes with the same
+    unknown-widening the reference's cluster-wide scan uses
+    (`ExperimentalOperations.deepAnalyzeDataFrame`,
+    `ExperimentalOperations.scala:89-132`: mapPartitions + reduce-merge).
+
+    ``frame`` is the HOST-LOCAL frame (pre `host_local_frame_to_global`).
+    Returns the local frame with globally-merged column metadata. Shapes
+    are exchanged as fixed-width int vectors through one
+    `process_allgather`; rank mismatches raise, like the reference.
+    """
+    from ..schema import Shape
+
+    analyzed = frame.analyze()
+    infos = [analyzed.info[name] for name in analyzed.columns]
+    max_rank = max((i.cell_shape.rank for i in infos), default=0)
+    multi = jax.process_count() > 1
+    if multi:
+        # agree on a global payload width first: ranks may differ across
+        # hosts, and allgather needs identical shapes on every process
+        from jax.experimental import multihost_utils
+
+        max_rank = int(
+            np.max(
+                np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([max_rank], dtype=np.int64)
+                    )
+                )
+            )
+        )
+    # encode: row per column = [rank, d0.., padded with -2]; unknown = -1
+    enc = np.full((len(infos), max_rank + 1), -2, dtype=np.int64)
+    for r, info in enumerate(infos):
+        enc[r, 0] = info.cell_shape.rank
+        for j, d in enumerate(info.cell_shape.dims):
+            enc[r, 1 + j] = -1 if d is None else d
+
+    if multi:
+        all_enc = np.asarray(multihost_utils.process_allgather(enc))
+    else:
+        all_enc = enc[None]
+
+    out = analyzed
+    for r, info in enumerate(infos):
+        merged = None
+        for p in range(all_enc.shape[0]):
+            rank = int(all_enc[p, r, 0])
+            dims = [
+                None if int(d) == -1 else int(d)
+                for d in all_enc[p, r, 1 : 1 + rank]
+            ]
+            shape = Shape(dims)
+            if merged is None:
+                merged = shape
+            else:
+                m = merged.merge(shape)
+                if m is None:
+                    raise ValueError(
+                        f"analyze_global: column {info.name!r} has rank "
+                        f"{merged.rank} on some hosts and {shape.rank} on "
+                        "others (incompatible, like the reference's "
+                        "analyze rank check)"
+                    )
+                merged = m
+        out = out.append_shape(info.name, merged)
+    return out
+
+
+def aggregate_global(
+    fetches,
+    grouped,
+    feed_dict=None,
+    fetch_names=None,
+):
+    """Distributed keyed aggregation over host-local rows.
+
+    Topology (the Catalyst partial-aggregation shuffle re-imagined for
+    hosts, `DebugRowOps.scala:554-599`): every process aggregates ITS
+    local rows with the host plan (exact or chunked), the small keyed
+    partial tables all-gather across processes, and partials re-combine
+    per key with the fetch's derived monoid (`api._chunk_combiners`),
+    size-weighted for Mean — so the full data never moves, only
+    #local-keys x cell-sized partials ride DCN.
+
+    Requires every fetch to be chunk-classifiable (Sum/Min/Max/Prod,
+    float Mean over row-local transforms); anything else raises — a
+    global exact plan would need shipping raw rows between hosts.
+    """
+    from .. import api as _api
+    from ..graph.analysis import analyze_graph
+
+    frame = grouped.frame
+    graph, fetch_list = _api._as_graph(fetches, fetch_names)
+    overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
+    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
+    combiners = _api._chunk_combiners(graph, fetch_list, summary)
+    if combiners is None:
+        raise ValueError(
+            "aggregate_global needs Reduce(rowwise(placeholder), axis=0) "
+            "fetches (Sum/Min/Max/Prod, float Mean); rewrite the graph or "
+            "aggregate host-locally"
+        )
+    bases = sorted(_api._base(f) for f in fetch_list)
+
+    # 1. local partial aggregation (+ per-group row counts for Mean)
+    local = _api.aggregate(graph, grouped, feed_dict, fetch_names=fetch_list)
+    key_cols = list(grouped.keys)
+    counts = np.bincount(
+        _api.factorize_keys(
+            key_cols, [frame.column(k).values for k in key_cols]
+        )[1]
+    )
+
+    if jax.process_count() == 1:
+        return local
+
+    # 2. all-gather the keyed partial tables (ragged across processes:
+    #    pad to the global max row count, mask by true length)
+    from jax.experimental import multihost_utils
+
+    nloc = local.nrows
+    lens = np.asarray(
+        multihost_utils.process_allgather(np.asarray([nloc], dtype=np.int64))
+    ).ravel()
+    nmax = int(lens.max())
+
+    def _gather(arr: np.ndarray) -> np.ndarray:
+        pad_shape = (nmax - arr.shape[0],) + arr.shape[1:]
+        padded = np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
+        return np.asarray(multihost_utils.process_allgather(padded))
+    gathered = {}
+    for name in key_cols + bases:
+        g = _gather(np.asarray(local.column(name).values))
+        gathered[name] = np.concatenate(
+            [g[p, : lens[p]] for p in range(g.shape[0])]
+        )
+    gcounts = _gather(counts.astype(np.int64))
+    weights = np.concatenate(
+        [gcounts[p, : lens[p]] for p in range(gcounts.shape[0])]
+    ).astype(np.float64)
+
+    # 3. re-combine partials per key with the derived monoids
+    key_out, inverse = _api.factorize_keys(
+        key_cols, [gathered[k] for k in key_cols]
+    )
+    num_groups = len(next(iter(key_out.values())))
+    order = np.argsort(inverse, kind="stable")
+    bounds = np.concatenate(
+        [[0], np.cumsum(np.bincount(inverse, minlength=num_groups))[:-1]]
+    ).astype(np.int64)
+    results = {
+        b: _api._monoid_combine(
+            gathered[b][order], bounds, combiners[b], weights=weights[order]
+        )
+        for b in bases
+    }
+    return _api._keyed_output(key_out, results, bases)
 
 
 def host_local_frame_to_global(

@@ -353,3 +353,42 @@ class TestMultiKeyAggregateMesh:
             for b in (0, 1)
         ]
         assert pdf["x"].tolist() == expect
+
+
+class TestMultihostHelpersSingleProcess:
+    """Single-process behavior of the multihost helpers (the multi-process
+    paths are exercised for real in test_multiprocess.py)."""
+
+    def test_analyze_global_one_process(self):
+        from tensorframes_tpu.parallel import multihost as mh
+
+        df = tfs.TensorFrame.from_dict(
+            {"v": [np.arange(3.0), np.arange(3.0) + 1]}
+        )
+        out = mh.analyze_global(df)
+        assert out.info["v"].cell_shape.dims == (3,)
+
+    def test_aggregate_global_one_process(self):
+        from tensorframes_tpu.parallel import multihost as mh
+
+        df = tfs.TensorFrame.from_dict(
+            {"k": np.array([0, 1, 0], dtype=np.int64), "x": np.arange(3.0)}
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        out = mh.aggregate_global(s, tfs.group_by(df, "k"))
+        got = dict(zip(out["k"].values.tolist(), out["x"].values.tolist()))
+        assert got == {0: 2.0, 1: 1.0}
+
+    def test_aggregate_global_rejects_unclassifiable(self):
+        from tensorframes_tpu.parallel import multihost as mh
+
+        df = tfs.TensorFrame.from_dict(
+            {"k": np.array([0, 1], dtype=np.int64), "x": np.arange(2.0)}
+        )
+        wrapped = dsl.identity(
+            dsl.reduce_min(tfs.block(df, "x", tf_name="x_input"), axes=[0])
+        ).named("x")
+        with pytest.raises(ValueError, match="aggregate_global"):
+            mh.aggregate_global(wrapped, tfs.group_by(df, "k"))

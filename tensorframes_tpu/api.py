@@ -368,6 +368,101 @@ def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
 
 
 # ---------------------------------------------------------------------------
+# bytes/string cells: identity pass-through (the reference's Binary scope)
+# ---------------------------------------------------------------------------
+
+
+def _split_string_passthrough(
+    graph: Graph, fetch_list: List[str]
+) -> Tuple[Graph, List[str], Dict[str, str]]:
+    """Partition fetches into device fetches and bytes pass-throughs.
+
+    The reference supports Binary cells at exactly one scope: a single
+    scalar cell carried through the conversion path, never computed on
+    (`datatypes.scala:577-581`). Mirrored here: a fetch whose node is an
+    Identity-chain over a string placeholder becomes a host-side cell
+    copy; any fetch that COMPUTES on string data raises. Returns the
+    device-only subgraph, the device fetches, and
+    ``{fetch base -> string placeholder name}``.
+    """
+    from .schema import ScalarType
+
+    str_phs = {
+        ph.name
+        for ph in graph.placeholders()
+        if ph.dtype_attr is ScalarType.string
+    }
+    if not str_phs:
+        return graph, fetch_list, {}
+    passthrough: Dict[str, str] = {}
+    device_fetches: List[str] = []
+    for f in fetch_list:
+        cur = _base(f)
+        ph = None
+        while True:
+            node = graph[cur]
+            if node.op in ("Placeholder", "PlaceholderV2"):
+                ph = node.name if node.name in str_phs else None
+                break
+            if node.op in ("Identity", "Snapshot", "StopGradient"):
+                cur = node.data_inputs()[0][0]
+                continue
+            break
+        if ph is not None:
+            passthrough[_base(f)] = ph
+        else:
+            device_fetches.append(f)
+    if device_fetches:
+        keep = {n.name for n in graph.toposort(device_fetches)}
+        touched = keep & str_phs
+        if touched:
+            raise ValueError(
+                f"fetches {sorted(_base(f) for f in device_fetches)} compute "
+                f"on bytes-column data (via {sorted(touched)}); bytes cells "
+                "support identity pass-through only (the reference's "
+                "one-scalar-cell Binary scope, datatypes.scala:577-581)"
+            )
+        dev_graph = Graph([n for n in graph.nodes if n.name in keep])
+    else:
+        dev_graph = Graph([])
+    return dev_graph, device_fetches, passthrough
+
+
+def _string_passthrough_columns(
+    passthrough: Dict[str, str],
+    frame: TensorFrame,
+    feed_dict: Optional[Dict[str, str]],
+) -> List[Column]:
+    """Resolve + validate the bytes columns and copy their cells."""
+    from .schema import ScalarType
+
+    feed_dict = feed_dict or {}
+    cols = []
+    for base, ph in passthrough.items():
+        col_name = feed_dict.get(ph, _default_column(ph, frame))
+        if col_name not in frame.info:
+            raise ValueError(
+                f"placeholder {ph!r} wants column {col_name!r} which is not "
+                f"in the frame (columns: {frame.columns})"
+            )
+        info = frame.info[col_name]
+        if info.dtype is not ScalarType.string:
+            raise ValueError(
+                f"placeholder {ph!r} is a bytes placeholder but column "
+                f"{col_name!r} has dtype {info.dtype.name}"
+            )
+        if info.cell_shape.rank != 0:
+            raise ValueError(
+                f"bytes column {col_name!r} must hold one scalar cell per "
+                "row (the reference's Binary scope, datatypes.scala:577-581)"
+            )
+        cols.append(
+            Column(base, list(frame.column(col_name).rows()), ScalarType.string)
+        )
+    return cols
+
+
+# ---------------------------------------------------------------------------
 # map_blocks
 # ---------------------------------------------------------------------------
 
@@ -392,18 +487,47 @@ def map_blocks(
     placeholders a per-call array instead of a column — updates between
     calls do NOT recompile (see `_check_bindings`).
     """
+    if callable(fetches) and not isinstance(fetches, dsl.Tensor):
+        if mesh is not None:
+            from .parallel import verbs as _pverbs
+
+            return _pverbs.map_blocks(
+                fetches, frame, mesh, feed_dict, trim, fetch_names, executor,
+                bindings=bindings,
+            )
+        return _map_blocks_fn(
+            fetches, frame, trim, executor or default_executor(),
+            bindings=bindings,
+        )
+    graph, fetch_list = _as_graph(fetches, fetch_names)
+    graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
+    if str_pass:
+        # bytes columns ride host-side in every topology: split them off
+        # BEFORE the mesh dispatch so mesh= behaves like the local path
+        if trim:
+            raise ValueError(
+                "map_blocks(trim): bytes pass-through requires a "
+                "row-preserving map"
+            )
+        str_cols = _string_passthrough_columns(str_pass, frame, feed_dict)
+        if fetch_list:
+            dev = map_blocks(
+                graph, frame, feed_dict, False, fetch_list, executor,
+                mesh=mesh, bindings=bindings,
+            )
+            dev_cols = [dev.column(_base(f)) for f in fetch_list]
+        else:
+            dev_cols = []
+        return _output_frame(frame, dev_cols + str_cols, append_input=True)
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
         return _pverbs.map_blocks(
-            fetches, frame, mesh, feed_dict, trim, fetch_names, executor,
+            graph, frame, mesh, feed_dict, trim, fetch_list, executor,
             bindings=bindings,
         )
     ex = executor or default_executor()
-    if callable(fetches) and not isinstance(fetches, dsl.Tensor):
-        return _map_blocks_fn(fetches, frame, trim, ex, bindings=bindings)
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
-    graph, fetch_list = _as_graph(fetches, fetch_names)
     overrides = _ph_overrides(
         graph, frame, feed_dict, block_level=True, bindings=bindings
     )
@@ -639,6 +763,15 @@ def map_rows(
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
         return _map_rows_fn(fetches, frame)
     graph, fetch_list = _as_graph(fetches, fetch_names)
+    graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
+    if str_pass:
+        str_cols = _string_passthrough_columns(str_pass, frame, feed_dict)
+        if fetch_list:
+            dev = map_rows(graph, frame, feed_dict, fetch_list, executor)
+            dev_cols = [dev.column(_base(f)) for f in fetch_list]
+        else:
+            dev_cols = []
+        return _output_frame(frame, dev_cols + str_cols, append_input=True)
     overrides = _ph_overrides(graph, frame, feed_dict, block_level=False)
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
     mapping = _match_columns(summary, frame, feed_dict, block_level=False)

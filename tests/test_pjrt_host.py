@@ -1,6 +1,11 @@
-"""Native C++ PJRT host tests — require exclusive access to a real PJRT
-plugin (the TPU under the driver), so they are gated behind
-``TFS_TEST_PJRT=1`` and skipped in the default CPU suite.
+"""Native C++ PJRT host tests.
+
+Auto-enabled whenever a PJRT plugin .so is discoverable AND passes a
+bounded child-process health probe (a wedged chip claim hangs client
+creation; the probe keeps that out of the suite). Force with
+``TFS_TEST_PJRT=1`` (skip the probe) or disable with ``TFS_TEST_PJRT=0``.
+Note jaxlib ships no dlopen-able CPU plugin (its CPU client is
+statically linked), so on plugin-less CI hosts these skip instantly.
 
 Run: ``TFS_TEST_PJRT=1 PYTHONPATH=.:/root/.axon_site python -m pytest
 tests/test_pjrt_host.py -q`` (fresh process; jax stays on CPU)."""
@@ -10,19 +15,26 @@ import os
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("TFS_TEST_PJRT") != "1",
-    reason="needs exclusive TPU access; set TFS_TEST_PJRT=1",
-)
-
 
 @pytest.fixture(scope="module")
 def host():
-    from tensorframes_tpu.runtime.pjrt_host import PjrtHost, default_plugin_path
+    # Gate lazily (NOT at collection time): the probe claims the shared
+    # device, so it must only run when these tests actually execute.
+    flag = os.environ.get("TFS_TEST_PJRT")
+    if flag is not None and flag != "1":
+        pytest.skip(f"disabled via TFS_TEST_PJRT={flag}")
+    from tensorframes_tpu.runtime.pjrt_host import (
+        PjrtHost,
+        default_plugin_path,
+        probe_plugin,
+    )
 
-    if default_plugin_path() is None:
-        pytest.skip("no PJRT plugin available")
-    return PjrtHost()
+    path = default_plugin_path()
+    if path is None:
+        pytest.skip("no PJRT plugin .so discoverable")
+    if flag != "1" and not probe_plugin(path):
+        pytest.skip(f"plugin {path} failed the health probe (wedged/busy)")
+    return PjrtHost(path)
 
 
 class TestPjrtHost:

@@ -72,3 +72,19 @@ class TestTypedMatrix:
         v = tfs.block(df, "v")
         out = tfs.map_blocks((v * npdt(2)).named("w"), df)
         np.testing.assert_array_equal(out["w"].values, vals * 2)
+
+
+class TestBytesRow:
+    """The bytes 'row' of the matrix: identity pass-through only, the
+    reference's Binary scope (`datatypes.scala:577-581`)."""
+
+    def test_identity_map_bytes(self):
+        from tensorframes_tpu.frame import Column, TensorFrame
+
+        df = TensorFrame(
+            [Column("x", [b"\x00\x01", b"", b"abc"], ScalarType.string)]
+        )
+        ph = dsl.placeholder(ScalarType.string, Shape(()), name="x")
+        out = tfs.map_blocks(dsl.identity(ph).named("y"), df)
+        assert out["y"].dtype is ScalarType.string
+        assert list(out["y"].rows()) == [b"\x00\x01", b"", b"abc"]

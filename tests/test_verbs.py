@@ -697,6 +697,95 @@ class TestAllEmptyFrames:
         assert out.column("z").values.shape == (0, 2)
 
 
+class TestBytesCells:
+    """Bytes/string cells through the map verbs — the reference's Binary
+    scope: one scalar cell per row, identity pass-through, never computed
+    on (`datatypes.scala:577-581`)."""
+
+    def _frame(self):
+        from tensorframes_tpu.frame import Column, TensorFrame
+
+        return TensorFrame(
+            [
+                Column("tag", [b"a", b"bb", b"ccc"], ScalarType.string),
+                Column("x", np.arange(3.0)),
+            ]
+        )
+
+    def test_map_blocks_passthrough_with_compute(self):
+        df = self._frame()
+        tag = dsl.placeholder(ScalarType.string, Shape(()), name="tag")
+        t = dsl.identity(tag).named("t")
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        out = tfs.map_blocks([z, t], df)
+        assert list(out["t"].rows()) == [b"a", b"bb", b"ccc"]
+        np.testing.assert_array_equal(out["z"].values, np.arange(3.0) + 1.0)
+        # TF outputs first, sorted; then passthrough inputs
+        assert out.columns == ["t", "z", "tag", "x"]
+
+    def test_map_rows_passthrough_only(self):
+        df = self._frame()
+        tag = dsl.placeholder(ScalarType.string, Shape(()), name="tag")
+        out = tfs.map_rows(dsl.identity(tag).named("t"), df)
+        assert list(out["t"].rows()) == [b"a", b"bb", b"ccc"]
+
+    def test_compute_on_bytes_rejected(self):
+        from tensorframes_tpu.graph.ir import Graph, GraphNode
+        from tensorframes_tpu.proto.graphdef import AttrValue
+
+        # Concat(tag, tag): computes ON the bytes column -> must raise
+        g = Graph(
+            [
+                GraphNode(
+                    "tag",
+                    "Placeholder",
+                    [],
+                    {
+                        "dtype": AttrValue.of_type(ScalarType.string),
+                        "shape": AttrValue.of_shape(Shape(())),
+                    },
+                ),
+                GraphNode("t", "StringJoin", ["tag", "tag"], {}),
+            ]
+        )
+        with pytest.raises(ValueError, match="bytes"):
+            tfs.map_blocks(g, self._frame(), fetch_names=["t"])
+
+    def test_feed_dict_rename(self):
+        df = self._frame()
+        b = dsl.placeholder(ScalarType.string, Shape(()), name="blob")
+        out = tfs.map_rows(
+            dsl.identity(b).named("t"), df, feed_dict={"blob": "tag"}
+        )
+        assert list(out["t"].rows()) == [b"a", b"bb", b"ccc"]
+
+    def test_mesh_map_blocks_with_bytes(self):
+        # bytes split off BEFORE the mesh dispatch: numeric part shards,
+        # bytes cells ride host-side, same result as the local path
+        from tensorframes_tpu.frame import Column, TensorFrame
+        from tensorframes_tpu.parallel import data_mesh
+
+        df = TensorFrame(
+            [
+                Column(
+                    "tag",
+                    [f"r{i}".encode() for i in range(16)],
+                    ScalarType.string,
+                ),
+                Column("x", np.arange(16.0)),
+            ]
+        )
+        tag = dsl.placeholder(ScalarType.string, Shape(()), name="tag")
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        out = tfs.map_blocks(
+            [z, dsl.identity(tag).named("t")], df, mesh=data_mesh()
+        )
+        assert [bytes(np.asarray(r)[()]) for r in out["t"].rows()] == [
+            f"r{i}".encode() for i in range(16)
+        ]
+        np.testing.assert_array_equal(out["z"].values, np.arange(16.0) + 1.0)
+
+
 class TestAggregateChunked:
     """Pow2 chunk decomposition for pathological group-size distributions:
     compiles stay O(log max_size) where round 1 compiled one program per

@@ -17,10 +17,14 @@ real TF would build (the reference asserts exactly this in its
 from __future__ import annotations
 
 import contextvars
+import weakref
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
+
+#: build() memo: fetch-id tuple -> (weakrefs for liveness check, result)
+_build_memo: Dict[tuple, tuple] = {}
 
 from ..proto.graphdef import AttrValue, TensorProto
 from ..schema import ScalarType, Shape
@@ -103,6 +107,9 @@ class Tensor:
     def named(self, name: str) -> "Tensor":
         """Request an explicit node name (`Operation.named`)."""
         self.requested_name = name
+        # renaming is the one post-construction mutation Tensors allow;
+        # drop memoized builds so the new name is picked up
+        _build_memo.clear()
         return self
 
     # -- operators (implicit constant conversion, dsl/Implicits.scala) ---
@@ -370,6 +377,15 @@ def build(fetches: Union[Tensor, Sequence[Tensor]]) -> (Graph, List[str]):
     """
     if isinstance(fetches, Tensor):
         fetches = [fetches]
+    # Memoize per fetch-tuple identity: verbs rebuild the graph on every
+    # call otherwise (re-serializing it dominated chained-verb dispatch).
+    # Tensors are immutable once created, so identity is a sound key.
+    memo_key = tuple(id(f) for f in fetches)
+    cached = _build_memo.get(memo_key)
+    if cached is not None and all(
+        a() is b for a, b in zip(cached[0], fetches)
+    ):
+        return cached[1]
     order: List[Tensor] = []
     seen: Dict[int, bool] = {}
 
@@ -421,4 +437,10 @@ def build(fetches: Union[Tensor, Sequence[Tensor]]) -> (Graph, List[str]):
         root = f.source or f
         n = names[id(root)]
         fetch_names.append(f"{n}:{f.idx}" if f.idx else n)
+    if len(_build_memo) > 256:  # bound the memo
+        _build_memo.clear()
+    _build_memo[memo_key] = (
+        [weakref.ref(f) for f in fetches],
+        (g, fetch_names),
+    )
     return g, fetch_names

@@ -176,6 +176,8 @@ def freeze_variables(graph: Graph) -> Graph:
                 ):
                     work.nodes[i] = _const_node(n.name, value)
                     work._by_name[n.name] = work.nodes[i]
+            # direct node splices bypass Graph.add's cache invalidation
+            work._fingerprint = None
         pending -= set(frozen)
 
     # Prune bookkeeping nodes and anything data-dependent on them.

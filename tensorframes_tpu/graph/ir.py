@@ -86,6 +86,7 @@ class Graph:
     def __init__(self, nodes: Optional[List[GraphNode]] = None):
         self.nodes: List[GraphNode] = []
         self._by_name: Dict[str, GraphNode] = {}
+        self._fingerprint: Optional[str] = None
         for n in nodes or []:
             self.add(n)
 
@@ -94,6 +95,7 @@ class Graph:
             raise ValueError(f"duplicate node name {node.name!r}")
         self.nodes.append(node)
         self._by_name[node.name] = node
+        self._fingerprint = None
         return node
 
     def __getitem__(self, name: str) -> GraphNode:
@@ -192,8 +194,12 @@ class Graph:
 
     def fingerprint(self) -> str:
         """Stable content hash; the compile-cache key component that replaces
-        the reference's per-task graph re-import (`DebugRowOps.scala:790`)."""
-        return hashlib.sha256(self.to_bytes()).hexdigest()[:16]
+        the reference's per-task graph re-import (`DebugRowOps.scala:790`).
+        Cached after first use (serializing the graph dominated verb
+        dispatch otherwise); `add` invalidates."""
+        if self._fingerprint is None:
+            self._fingerprint = hashlib.sha256(self.to_bytes()).hexdigest()[:16]
+        return self._fingerprint
 
     def __repr__(self) -> str:
         return f"Graph({len(self.nodes)} nodes)"

@@ -33,7 +33,32 @@ __all__ = [
     "default_plugin_path",
     "probe_plugin",
     "stablehlo_for",
+    "wait_or_terminate",
 ]
+
+
+def wait_or_terminate(proc, timeout_s: float, grace_s: float = 20.0):
+    """Wait for a child with a deadline; on overrun, SIGTERM + grace but
+    NEVER SIGKILL — a force-killed process mid device-claim leaks the
+    claim and wedges a shared chip for every later process. If the child
+    ignores SIGTERM it is left running (and reported), which is the
+    lesser evil. Returns the child's returncode, or None on overrun."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        return proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            print(
+                f"# child {proc.pid} ignored SIGTERM; leaving it running "
+                "rather than SIGKILLing mid device-claim",
+                file=_sys.stderr,
+            )
+        return None
 
 # PJRT_Buffer_Type ordinals (pjrt_c_api.h enum order).
 _PJRT_TYPE = {
@@ -110,9 +135,8 @@ def probe_plugin(path: str, timeout_s: float = 60.0) -> bool:
     keeps that failure bounded and out of the caller's process.
 
     The default timeout sits well above worst-case cold init (tens of
-    seconds on TPU), and an overrunning child gets SIGTERM plus a grace
-    period before SIGKILL — force-killing a process MID device claim is
-    itself a known way to leak the claim and wedge a shared chip."""
+    seconds on TPU); overruns are handled by `wait_or_terminate` —
+    SIGTERM with grace, never SIGKILL mid device-claim."""
     import subprocess
     import sys
 
@@ -125,16 +149,7 @@ def probe_plugin(path: str, timeout_s: float = 60.0) -> bool:
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
-    try:
-        return proc.wait(timeout=timeout_s) == 0
-    except subprocess.TimeoutExpired:
-        proc.terminate()  # graceful: lets the plugin release its claim
-        try:
-            proc.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-        return False
+    return wait_or_terminate(proc, timeout_s) == 0
 
 
 def _compile_options_bytes() -> bytes:

@@ -5,10 +5,16 @@ as a tracer only — no JAX backend touches the device), then compile and
 EVERY execution (H2D, run, D2H) goes through the native host
 (native/pjrt_host.cc). Pass ``executor=NativeExecutor(...)`` to any verb.
 
+All single-program execution kinds run natively: plain block calls,
+vmapped per-row programs, `lax.scan` folds, and the chunked-aggregate
+stages each lower to ONE StableHLO module, which is exactly what the
+host consumes. Only the shard_map kinds (multi-device mesh programs)
+need the in-process JAX backend and remain opt-in via ``jax_fallback``.
+
 This completes the reference-parity story for the native runtime: where
-TensorFrames' workers called libtensorflow through JNI per partition
-(`DebugRowOps.scala:790-809`), the verbs here call a C++ PJRT host that
-owns the TPU client.
+TensorFrames' workers called libtensorflow through JNI per partition for
+EVERY verb (`DebugRowOps.scala:790-809`), the verbs here call a C++ PJRT
+host that owns the TPU client.
 """
 
 from __future__ import annotations
@@ -22,6 +28,11 @@ from ..ops.lowering import build_callable
 from .pjrt_host import PjrtHost, stablehlo_for
 
 __all__ = ["NativeExecutor"]
+
+# shard_map programs span a multi-device mesh; the native host is a
+# single-program single-device engine by design, so these kinds need the
+# in-process JAX executor (see `cached`).
+_MESH_KIND_PREFIXES = ("shmap-", "shred-", "shfold-", "shagg-")
 
 
 class NativeExecutor:
@@ -40,24 +51,75 @@ class NativeExecutor:
         self._allow_jax_fallback = jax_fallback
         self._jax_fallback = None
 
-    def cached(self, kind, graph, fetches, feed_names, make):
-        # Non-block execution kinds (vmapped rows, scan folds, shard_map)
-        # need the in-process JAX executor: the native host is a
-        # single-program-at-a-time engine by design. Running a JAX backend
-        # next to a native host that owns the same device is unsafe
-        # (double TPU client), so it is strictly opt-in.
-        if not self._allow_jax_fallback:
-            raise NotImplementedError(
-                f"NativeExecutor runs block-level programs only; {kind!r} "
-                "execution needs the in-process JAX executor. Construct "
-                "NativeExecutor(jax_fallback=True) ONLY if the JAX backend "
-                "does not own the same device as the native host."
-            )
-        if self._jax_fallback is None:
-            from .executor import Executor
+    def _native_run(self, traceable: Callable) -> Callable:
+        """Wrap a jittable function (possibly taking/returning pytrees)
+        as a native-host call: lower per concrete input-shape signature,
+        compile through the host, execute with flat numpy buffers, and
+        rebuild the output pytree. The lowered module's parameter and
+        result orders are the flattened pytree orders, which is what
+        makes this correct for dict-carrying folds too."""
+        exe_cache: Dict[Tuple, Tuple] = {}
 
-            self._jax_fallback = Executor()
-        return self._jax_fallback.cached(kind, graph, fetches, feed_names, make)
+        def run(*args):
+            import jax
+
+            flat_in, in_tree = jax.tree_util.tree_flatten(args)
+            flat_in = [np.asarray(a) for a in flat_in]
+            shape_key = (
+                in_tree,
+                tuple((a.shape, str(a.dtype)) for a in flat_in),
+            )
+            entry = exe_cache.get(shape_key)
+            if entry is None:
+                structs = jax.tree_util.tree_unflatten(
+                    in_tree,
+                    [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat_in],
+                )
+                out_shape = jax.eval_shape(traceable, *structs)
+                out_flat, out_tree = jax.tree_util.tree_flatten(out_shape)
+                out_specs = [
+                    (tuple(o.shape), np.dtype(o.dtype)) for o in out_flat
+                ]
+                mlir = stablehlo_for(traceable, *structs)
+                exe = self.host.compile(mlir)
+                self.compile_count += 1
+                entry = (exe, out_specs, out_tree)
+                exe_cache[shape_key] = entry
+            exe, out_specs, out_tree = entry
+            outs = exe(*flat_in, out_specs=out_specs)
+            return jax.tree_util.tree_unflatten(out_tree, outs)
+
+        return run
+
+    def cached(self, kind, graph, fetches, feed_names, make):
+        if kind.startswith(_MESH_KIND_PREFIXES):
+            # Mesh execution needs the in-process JAX executor. Running a
+            # JAX backend next to a native host that owns the same device
+            # is unsafe (double TPU client), so it is strictly opt-in.
+            if not getattr(self, "_allow_jax_fallback", False):
+                raise NotImplementedError(
+                    f"NativeExecutor runs single-device programs; {kind!r} "
+                    "(shard_map over a mesh) needs the in-process JAX "
+                    "executor. Construct NativeExecutor(jax_fallback=True) "
+                    "ONLY if the JAX backend does not own the same device "
+                    "as the native host."
+                )
+            if self._jax_fallback is None:
+                from .executor import Executor
+
+                self._jax_fallback = Executor()
+            return self._jax_fallback.cached(
+                kind, graph, fetches, feed_names, make
+            )
+        key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            # `make()` hands back a jax.jit-wrapped program; it is used
+            # here purely as a lowering recipe — execution never touches
+            # the in-process JAX backend.
+            fn = self._native_run(make())
+            self._cache[key] = fn
+        return fn
 
     def callable_for(
         self,
@@ -65,36 +127,10 @@ class NativeExecutor:
         fetches: Sequence[str],
         feed_names: Sequence[str],
     ) -> Callable:
-        key = (graph.fingerprint(), tuple(fetches), tuple(feed_names))
-        fn = self._cache.get(key)
-        if fn is not None:
-            return fn
-        raw = build_callable(graph, list(fetches), list(feed_names))
-        exe_cache: Dict[Tuple, Tuple] = {}
-
-        def run(*arrays):
-            import jax
-
-            arrays = [np.asarray(a) for a in arrays]
-            shape_key = tuple((a.shape, str(a.dtype)) for a in arrays)
-            entry = exe_cache.get(shape_key)
-            if entry is None:
-                import jax.numpy as jnp
-
-                structs = [
-                    jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays
-                ]
-                out_structs = jax.eval_shape(raw, *structs)
-                out_specs = [
-                    (tuple(o.shape), np.dtype(o.dtype)) for o in out_structs
-                ]
-                mlir = stablehlo_for(raw, *structs)
-                exe = self.host.compile(mlir)
-                self.compile_count += 1
-                entry = (exe, out_specs)
-                exe_cache[shape_key] = entry
-            exe, out_specs = entry
-            return tuple(exe(*arrays, out_specs=out_specs))
-
-        self._cache[key] = run
-        return run
+        return self.cached(
+            "block",
+            graph,
+            fetches,
+            feed_names,
+            lambda: build_callable(graph, list(fetches), list(feed_names)),
+        )

@@ -73,13 +73,8 @@ class TestPjrtHost:
 
     def test_verbs_through_native_executor(self, host):
         import tensorframes_tpu as tfs
-        from tensorframes_tpu.runtime.native_executor import NativeExecutor
 
-        ex = NativeExecutor.__new__(NativeExecutor)
-        ex.host = host
-        ex._cache = {}
-        ex.compile_count = 0
-
+        ex = _executor_on(host)
         df = tfs.TensorFrame.from_dict(
             {"x": np.arange(6, dtype=np.float32)}, num_blocks=2
         )
@@ -89,3 +84,86 @@ class TestPjrtHost:
             np.asarray(out["z"].values), np.arange(6.0, dtype=np.float32) + 3
         )
         assert ex.compile_count >= 1
+
+    def test_map_rows_native(self, host):
+        # vmap-rows is a single XLA program: it must run natively, with
+        # no jax_fallback constructed (the reference ran every verb
+        # through its native runtime, DebugRowOps.scala:790-809).
+        import tensorframes_tpu as tfs
+
+        ex = _executor_on(host)
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(8, dtype=np.float32).reshape(4, 2)}
+        )
+        y = (tfs.row(df, "x") * 2.0).named("y")
+        out = tfs.map_rows(y, df, executor=ex)
+        np.testing.assert_array_equal(
+            np.asarray(out["y"].values),
+            np.arange(8, dtype=np.float32).reshape(4, 2) * 2,
+        )
+        assert ex._jax_fallback_unused()
+
+    def test_reduce_rows_native(self, host):
+        # The scan fold also lowers to one StableHLO module (the pair
+        # graph rolled into stablehlo.while) and runs natively.
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        ex = _executor_on(host)
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(1, 6, dtype=np.float64)}, num_blocks=2
+        )
+        x1 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float64, Shape(()), name="x_2")
+        out = tfs.reduce_rows(dsl.add(x1, x2).named("x"), df, executor=ex)
+        assert float(out) == 15.0
+        assert ex._jax_fallback_unused()
+
+    def test_aggregate_native(self, host):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+
+        ex = _executor_on(host)
+        df = tfs.TensorFrame.from_dict(
+            {
+                "key": np.array([0, 1, 0, 1, 0], dtype=np.int64),
+                "x": np.array([1.0, 10.0, 2.0, 20.0, 3.0], np.float64),
+            }
+        )
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(x, tfs.group_by(df, "key"), executor=ex)
+        np.testing.assert_allclose(
+            np.asarray(out["x"].values), np.array([6.0, 30.0])
+        )
+        assert ex._jax_fallback_unused()
+
+    def test_reduce_blocks_native(self, host):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+
+        ex = _executor_on(host)
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(10, dtype=np.float64)}, num_blocks=3
+        )
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        x = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.reduce_blocks(x, df, executor=ex)
+        assert float(out) == 45.0
+        assert ex._jax_fallback_unused()
+
+
+def _executor_on(host):
+    """A NativeExecutor bound to the module-scoped host (bypasses
+    __init__ so only ONE host claims the plugin per test session)."""
+    from tensorframes_tpu.runtime.native_executor import NativeExecutor
+
+    ex = NativeExecutor.__new__(NativeExecutor)
+    ex.host = host
+    ex._cache = {}
+    ex.compile_count = 0
+    ex._allow_jax_fallback = False
+    ex._jax_fallback = None
+    ex._jax_fallback_unused = lambda: ex._jax_fallback is None
+    return ex

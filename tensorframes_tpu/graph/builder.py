@@ -102,6 +102,13 @@ class Tensor:
         self.scope_path = _scope_stack.get()
         self.idx = idx
         self.source = source  # for multi-output handles: the defining node
+        # (consumer Tensor, suffix): name this node "<consumer>/<suffix>"
+        # at build time — how TF scopes helper constants under the op
+        # that owns them (e.g. Sum's "reduction_indices")
+        self.name_relative = None
+        # anonymous-name counter base when it differs from the op type
+        # (TF names anonymous AddV2 nodes "Add", RealDiv "div", ...)
+        self.name_base = None
 
     # -- naming ----------------------------------------------------------
     def named(self, name: str) -> "Tensor":
@@ -199,7 +206,19 @@ def ones(shape, dtype: ScalarType = ScalarType.float64) -> Tensor:
 
 
 def fill(shape, value, dtype: Optional[ScalarType] = None) -> Tensor:
-    return constant(np.full(shape, value, dtype=dtype.np_dtype if dtype else None))
+    # A real Fill node (dims/value Const children scoped under it), the
+    # wire shape TF emits — not a constant-folded Const
+    dims = constant(np.asarray(shape, dtype=np.int32))
+    val = constant(value, dtype=dtype)
+    t = _nary(
+        "Fill",
+        [dims, val],
+        val.dtype,
+        {"index_type": AttrValue.of_type(ScalarType.int32)},
+    )
+    dims.name_relative = (t, "dims")
+    val.name_relative = (t, "value")
+    return t
 
 
 def _nary(
@@ -220,7 +239,12 @@ def identity(x: Tensor, name: Optional[str] = None) -> Tensor:
 
 
 def add(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
-    return _nary("Add", [a, b], _same_dtype(a, b, "add"), name=name)
+    # AddV2: what modern TF emits for `tf.add` — the golden structural
+    # suite pins our export to the installed TF's wire format (the
+    # import path still accepts legacy "Add" from reference fixtures)
+    t = _nary("AddV2", [a, b], _same_dtype(a, b, "add"), name=name)
+    t.name_base = "Add"  # TF's anonymous-name base for add
+    return t
 
 
 def sub(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
@@ -232,13 +256,24 @@ def mul(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
 
 
 def div(a: Tensor, b: Tensor, name: Optional[str] = None) -> Tensor:
-    return _nary("Div", [a, b], _same_dtype(a, b, "div"), name=name)
+    # Modern TF's `tf.div` emits RealDiv for floats (true division) and
+    # keeps integer Div truncation; match its wire format per dtype so
+    # the golden structural suite holds across the dtype matrix.
+    dt = _same_dtype(a, b, "div")
+    op = "RealDiv" if dt.is_floating else "Div"
+    t = _nary(op, [a, b], dt, name=name)
+    if op == "RealDiv":
+        t.name_base = "div"  # TF's anonymous-name base for tf.div
+    return t
 
 
 def matmul(a: Tensor, b: Tensor, transpose_a=False, transpose_b=False) -> Tensor:
     extra = {
         "transpose_a": AttrValue.of_bool(transpose_a),
         "transpose_b": AttrValue.of_bool(transpose_b),
+        # modern TF stamps gradient-precision flags on every MatMul
+        "grad_a": AttrValue.of_bool(False),
+        "grad_b": AttrValue.of_bool(False),
     }
     return _nary("MatMul", [a, b], _same_dtype(a, b, "matmul"), extra)
 
@@ -302,7 +337,10 @@ def _reducer(
         "keep_dims": AttrValue.of_bool(keep_dims),
         "Tidx": AttrValue.of_type(ScalarType.int32),
     }
-    return _nary(op, [x, idx], x.dtype, extra)
+    t = _nary(op, [x, idx], x.dtype, extra)
+    # TF scopes the axis constant under the reduce node's (final) name
+    idx.name_relative = (t, "reduction_indices")
+    return t
 
 
 def reduce_sum(x: Tensor, axes=None, keep_dims=False, name=None) -> Tensor:
@@ -405,10 +443,12 @@ def build(fetches: Union[Tensor, Sequence[Tensor]]) -> (Graph, List[str]):
     names: Dict[int, str] = {}
     used = set()
     for t in order:
+        if t.name_relative is not None:
+            continue  # named after its consumer in the second pass
         if t.requested_name:
             name = "/".join(t.scope_path + (t.requested_name,))
         else:
-            base = "/".join(t.scope_path + (t.op,))
+            base = "/".join(t.scope_path + (t.name_base or t.op,))
             k = counters.get(base, 0)
             name = base if k == 0 else f"{base}_{k}"
             counters[base] = k + 1
@@ -416,6 +456,16 @@ def build(fetches: Union[Tensor, Sequence[Tensor]]) -> (Graph, List[str]):
                 k = counters[base]
                 name = f"{base}_{k}"
                 counters[base] = k + 1
+        if name in used:
+            raise ValueError(f"duplicate node name {name!r} in DSL graph")
+        used.add(name)
+        names[id(t)] = name
+    for t in order:
+        if t.name_relative is None:
+            continue
+        consumer, suffix = t.name_relative
+        root = consumer.source or consumer
+        name = f"{names[id(root)]}/{suffix}"
         if name in used:
             raise ValueError(f"duplicate node name {name!r} in DSL graph")
         used.add(name)

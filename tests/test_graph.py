@@ -84,7 +84,9 @@ class TestBuilderDSL:
         b = x + 2.0
         g, _ = dsl.build([a, b])
         names = [n.name for n in g.nodes]
+        # nodes carry op AddV2 but TF's anonymous-name base is "Add"
         assert "Add" in names and "Add_1" in names
+        assert {n.op for n in g.nodes if n.name.startswith("Add")} == {"AddV2"}
 
     def test_scope_prefix(self):
         x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")

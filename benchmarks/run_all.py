@@ -25,6 +25,9 @@ SMOKE_SIZES = {
     "INCEPTION_WIDTH": "8",
     "RAGGED_ROWS": "20000",
     "RAGGED_LOOP_ROWS": "500",
+    "OVERLAP_CHUNK_ROWS": "200000",
+    "OVERLAP_CHUNKS": "6",
+    "OVERLAP_THROTTLE_MS": "20",
 }
 
 
@@ -42,6 +45,7 @@ def main():
         "aggregate_bench",
         "inception_bench",
         "ragged_map_rows_bench",
+        "stream_overlap_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
 

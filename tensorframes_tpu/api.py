@@ -1056,6 +1056,7 @@ def reduce_blocks_stream(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
+    fold_every: Optional[int] = 64,
 ):
     """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
     hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
@@ -1063,32 +1064,49 @@ def reduce_blocks_stream(
     synthesis/IO overlaps device execution; partials combine with the
     same graph.
 
-    The streaming form is what makes the BASELINE north star (1B-row
-    vector reduce_sum) run in bounded host memory.
+    The partial table itself is tree-folded every ``fold_every`` chunks,
+    so host memory is bounded by O(fold_every) partials no matter how
+    long the stream — the streaming form is what makes the BASELINE
+    north star (1B-row vector reduce_sum) run in bounded host memory
+    unconditionally.
+
+    Combining partials through the same graph assumes the reduce is
+    ASSOCIATIVE over blocks (sum/min/max/...) — the same contract as the
+    reference's pairwise partial combine (`reducePairBlock`,
+    `DebugRowOps.scala:748-757`). A non-associative graph (e.g. Mean:
+    a fold result re-enters the next combine weighted as ONE chunk) is
+    not exact under tree-folding; pass ``fold_every=None`` to keep every
+    chunk partial for a single equally-weighted final combine at the
+    cost of O(#chunks) host memory.
     """
     graph, fetch_list = _as_graph(fetches, fetch_names)
-    partials: List = []
+    if fold_every is not None:
+        fold_every = max(2, int(fold_every))
+
+    def _combine(parts: List[Dict]) -> Dict:
+        stacked = TensorFrame.from_dict(
+            {
+                b: np.stack([np.asarray(p[b]) for p in parts])
+                for b in parts[0]
+            }
+        )
+        r = reduce_blocks(
+            graph, stacked, None, fetch_names=fetch_list, executor=executor
+        )
+        return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+
+    partials: List[Dict] = []
     for f in _prefetch_iter(frames):
         r = reduce_blocks(
             graph, f, feed_dict, fetch_names=fetch_list,
             executor=executor, mesh=mesh,
         )
         partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
+        if fold_every is not None and len(partials) >= fold_every:
+            partials = [_combine(partials)]
     if not partials:
         raise ValueError("reduce_blocks_stream over an empty iterator")
-    if len(partials) == 1:
-        out = partials[0]
-    else:
-        stacked = TensorFrame.from_dict(
-            {
-                b: np.stack([np.asarray(p[b]) for p in partials])
-                for b in partials[0]
-            }
-        )
-        r = reduce_blocks(
-            graph, stacked, None, fetch_names=fetch_list, executor=executor
-        )
-        out = r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+    out = partials[0] if len(partials) == 1 else _combine(partials)
     if len(fetch_list) == 1:
         return out[_base(fetch_list[0])]
     return out
@@ -1340,17 +1358,42 @@ def _chunk_combiners(
         # walk the transform subgraph: placeholder/const leaves, rowwise ops
         seen = set()
         stack = [data_in[0][0]]
+        ph_ranks = set()
+        const_shapes = []
         while stack:
             name = stack.pop()
             if name in seen:
                 continue
             seen.add(name)
             n = graph[name]
-            if n.op in ("Placeholder", "PlaceholderV2", "Const"):
+            if n.op in ("Placeholder", "PlaceholderV2"):
+                info = summary.inputs.get(name)
+                if info is None:
+                    return None
+                ph_ranks.add(len(info.shape.dims))
+                continue
+            if n.op == "Const":
+                const_shapes.append(
+                    n.attrs["value"].value.to_numpy().shape
+                )
                 continue
             if n.op not in _ROWWISE_OPS:
                 return None
             stack.extend(src for src, _ in n.data_inputs())
+        if len(ph_ranks) != 1:
+            return None  # mixed feed ranks: lead-axis alignment is murky
+        lead_rank = ph_ranks.pop()
+        for cshape in const_shapes:
+            # A lead-rank constant broadcasts along the group-size axis;
+            # chunked feeds slice that axis, so partials would mismatch
+            # (surfacing as an XLA broadcast error deep in the chunk
+            # stage). Only sub-lead-rank constants — or an explicit
+            # size-1 lead — are chunk-invariant; anything else falls
+            # back to the exact whole-group plan.
+            if len(cshape) > lead_rank or (
+                len(cshape) == lead_rank and cshape and cshape[0] != 1
+            ):
+                return None
         out[_base(f)] = _CHUNK_COMBINERS[node.op]
     return out
 

@@ -221,7 +221,12 @@ class TestBenchmarkSmoke:
         names = {m["metric"] for m in metrics}
         assert len(metrics) >= 9, names
         for m in metrics:
-            assert m["value"] > 0, m
+            if m["unit"] == "efficiency":
+                # overlap efficiency is a 0..1 ratio; at smoke sizes the
+                # measured work is microseconds and 0.0 is legitimate
+                assert 0.0 <= m["value"] <= 1.0, m
+            else:
+                assert m["value"] > 0, m
 
 
 class TestCostAnalysis:

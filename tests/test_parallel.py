@@ -392,3 +392,26 @@ class TestMultihostHelpersSingleProcess:
         ).named("x")
         with pytest.raises(ValueError, match="aggregate_global"):
             mh.aggregate_global(wrapped, tfs.group_by(df, "k"))
+
+
+class TestGidDtype:
+    """Mesh aggregate group-id dtype: int32 until the 2^31 key cliff,
+    then int64 — or a loud refusal when jax x64 would silently truncate
+    int64 ids back to int32 (parallel/verbs._gid_dtype)."""
+
+    def test_small_cardinality_stays_int32(self):
+        from tensorframes_tpu.parallel.verbs import _gid_dtype
+
+        assert _gid_dtype(10) == np.int32
+        assert _gid_dtype(2**31 - 1) == np.int32
+
+    def test_past_cliff_widens_or_refuses(self):
+        import jax
+
+        from tensorframes_tpu.parallel.verbs import _gid_dtype
+
+        if jax.config.read("jax_enable_x64"):
+            assert _gid_dtype(2**31) == np.int64
+        else:
+            with pytest.raises(ValueError, match="int32 group ids"):
+                _gid_dtype(2**31)

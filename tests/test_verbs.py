@@ -457,6 +457,37 @@ class TestReduceBlocksStream:
         with pytest.raises(ValueError, match="empty"):
             tfs.reduce_blocks_stream(s, [])
 
+    def test_partials_tree_folded_bounded(self, monkeypatch):
+        # The partial table must stay O(fold_every) on the host no matter
+        # how long the stream: every combine call sees a stacked frame of
+        # lead dim <= fold_every (round-2 weakness: partials grew
+        # O(#chunks) before one final combine).
+        from tensorframes_tpu import api as _api
+
+        leads = []
+        real_reduce_blocks = _api.reduce_blocks
+
+        def spy(graph, frame, feed_dict=None, **kw):
+            leads.append(frame.nrows)
+            return real_reduce_blocks(graph, frame, feed_dict, **kw)
+
+        monkeypatch.setattr(_api, "reduce_blocks", spy)
+        # 5-row chunks so combine calls (over partials, <= fold_every=4
+        # rows) are distinguishable from chunk calls (5 rows)
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.arange(i * 5.0, i * 5.0 + 5)})
+            for i in range(11)
+        ]
+        x_input = tfs.block(chunks[0], "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        total = tfs.reduce_blocks_stream(s, iter(chunks), fold_every=4)
+        assert float(total) == np.arange(55.0).sum()
+        folds = [n for n in leads if n != 5]
+        # 11 chunks, fold_every=4: folds at chunks 4/8, then 1 fold + 3
+        # tail chunks combine at the end — never more than 4 partials live
+        assert len(folds) >= 2
+        assert max(folds) <= 4
+
 
 class TestBindings:
     """Per-call bound placeholders: jit arguments, not baked constants."""
@@ -899,6 +930,68 @@ class TestAggregateChunked:
         np.testing.assert_allclose(
             out["x"], [x[k == g].min() for g in range(2)]
         )
+
+    def test_lead_rank_constant_rejected_by_classifier(self):
+        # A constant shaped (size, *cell) broadcasts along the GROUP-SIZE
+        # axis: chunked feeds slice that axis, so the chunk stage would
+        # die with an XLA broadcast error. The classifier must refuse it
+        # (clean exact-plan fallback) rather than rely on upstream probes
+        # to have caught the size-specialization.
+        from tensorframes_tpu.api import _chunk_combiners
+        from tensorframes_tpu.graph.analysis import NodeSummary
+        from tensorframes_tpu.graph.analysis import GraphSummary
+
+        def graph_with_const(cvals):
+            x_input = dsl.placeholder(
+                ScalarType.float64, Shape((None,)), name="x_input"
+            )
+            w = dsl.constant(np.asarray(cvals))
+            s = dsl.reduce_sum(x_input * w, axes=[0]).named("x")
+            g, fl = dsl.build(s)
+            summary = GraphSummary(
+                inputs={
+                    "x_input": NodeSummary(
+                        "x_input", True, False,
+                        ScalarType.float64, Shape((None,)),
+                    )
+                },
+                outputs={
+                    "x": NodeSummary(
+                        "x", False, True, ScalarType.float64, Shape(())
+                    )
+                },
+            )
+            return g, fl, summary
+
+        # lead-rank (5,) constant against a rank-1 feed: refused
+        g, fl, summary = graph_with_const(np.arange(1.0, 6.0))
+        assert _chunk_combiners(g, fl, summary) is None
+        # scalar constant: chunk-invariant, accepted
+        g, fl, summary = graph_with_const(2.0)
+        assert _chunk_combiners(g, fl, summary) == {"x": "sum"}
+
+    def test_sub_lead_constant_still_chunks(self):
+        # A scalar (sub-lead-rank) constant is chunk-invariant: the
+        # classifier must keep accepting it (regression guard for the
+        # lead-rank rejection not over-reaching).
+        from tensorframes_tpu import config
+        from tensorframes_tpu.runtime.executor import Executor
+
+        sizes = np.arange(1, 101)
+        df = self._frame(sizes)
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input * dsl.constant(2.0), axes=[0]).named("x")
+        ex = Executor()
+        out = tfs.aggregate(
+            s, tfs.group_by(df, "k"), executor=ex
+        ).to_pandas()
+        (vraw,) = ex._cache.values()
+        assert vraw._cache_size() <= 20  # chunked, not one-per-size
+        out = out.sort_values("k").reset_index(drop=True)
+        k = df["k"].values
+        x = df["x"].values
+        want = [2.0 * x[k == g].sum() for g in range(len(sizes))]
+        np.testing.assert_allclose(out["x"], want, rtol=1e-12)
 
     def test_compile_count_bounded_many_distinct_sizes(self):
         from tensorframes_tpu.runtime.executor import Executor

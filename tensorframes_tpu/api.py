@@ -1398,6 +1398,82 @@ def _chunk_combiners(
     return out
 
 
+def _aggregate_segment(
+    ex,
+    graph: Graph,
+    fetch_list: List[str],
+    combiners: Dict[str, str],
+    feed_names: List[str],
+    mapping: Dict[str, str],
+    grouped: GroupedFrame,
+) -> TensorFrame:
+    """Sort-free keyed aggregation for classified monoid graphs.
+
+    The rowwise transform of every fetch runs over ALL rows in one XLA
+    call, then one device ``segment_<op>`` per fetch produces the dense
+    (num_groups, *cell) result — no host argsort, no per-size or chunk
+    programs. This is the single-device analogue of the mesh path's
+    segment_sum+psum (`parallel/verbs.py`), generalized to min/max/prod
+    and size-weighted mean via the same structural classifier. FP
+    accumulation order differs from the whole-group exact plan (the
+    documented reassociation tolerance for reductions; the reference's
+    own driver-side pairwise combine reassociated too,
+    `DebugRowOps.scala:748-757`)."""
+    frame = grouped.frame
+    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
+    num_groups = len(next(iter(key_out.values())))
+    bases = [_base(f) for f in fetch_list]
+    # the data operand of each root reduce = the rowwise transform output
+    roots = [graph[_base(f)].data_inputs()[0][0] for f in fetch_list]
+    comb_sig = ",".join(combiners[b] for b in bases)
+
+    needs_counts = "mean" in combiners.values()
+
+    def make():
+        raw = build_callable(graph, roots, feed_names)
+        segment_of = {
+            "sum": jax.ops.segment_sum,
+            "min": jax.ops.segment_min,
+            "max": jax.ops.segment_max,
+            "prod": jax.ops.segment_prod,
+        }
+
+        def fn(gid, counts, *feeds):
+            outs = raw(*feeds)
+            res = []
+            for b, o in zip(bases, outs):
+                comb = combiners[b]
+                if comb == "mean":
+                    s = jax.ops.segment_sum(o, gid, num_groups)
+                    c = counts.astype(o.dtype).reshape(
+                        (-1,) + (1,) * (s.ndim - 1)
+                    )
+                    res.append(s / c)
+                else:
+                    res.append(segment_of[comb](o, gid, num_groups))
+            return tuple(res)
+
+        return jax.jit(fn)
+
+    sfn = ex.cached(
+        f"segagg-{num_groups}-{comb_sig}", graph, fetch_list, feed_names, make
+    )
+    gid = inverse.astype(np.int32 if num_groups <= 2**31 - 1 else np.int64)
+    # counts ride as exact int32 and convert to the fetch dtype in-graph;
+    # the O(n) bincount is skipped entirely when no fetch is a Mean
+    counts = (
+        np.bincount(inverse, minlength=num_groups).astype(np.int32)
+        if needs_counts
+        else np.zeros(0, np.int32)
+    )
+    feeds = [frame.column(mapping[n]).values for n in feed_names]
+    outs = sfn(gid, counts, *feeds)
+    maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
+    results = {b: np.asarray(o) for b, o in zip(bases, outs)}
+    return _keyed_output(key_out, results, bases)
+
+
 def _monoid_combine(
     tab: np.ndarray,
     bounds: np.ndarray,
@@ -1550,6 +1626,22 @@ def aggregate(
     _require_dense(frame, list(mapping.values()), "aggregate")
 
     feed_names = sorted(summary.inputs)
+
+    from . import config as _config
+
+    # one structural classification serves the segment fast path AND the
+    # chunked plan's eligibility check below
+    classified = _chunk_combiners(graph, fetch_list, summary)
+    if (
+        _config.get().aggregate_segment_fast
+        and frame.nrows > 0
+        and classified is not None
+    ):
+        # sort-free: one XLA call over all rows + device segment ops
+        return _aggregate_segment(
+            ex, graph, fetch_list, classified, feed_names, mapping, grouped
+        )
+
     key_out, num_groups, counts, starts, col_data = _group_plan(
         grouped, mapping, feed_names
     )
@@ -1566,14 +1658,12 @@ def aggregate(
     bases = [_base(f) for f in fetch_list]
     results: Dict[str, np.ndarray] = {}
 
-    from . import config as _config
-
     unique_sizes = np.unique(counts[counts > 0])
     combiners = None
     if len(unique_sizes) > _config.get().aggregate_exact_size_limit:
         # only chunk when the graph is provably chunk-safe; otherwise the
         # exact plan keeps correctness at the cost of more compiles
-        combiners = _chunk_combiners(graph, fetch_list, summary)
+        combiners = classified
     if combiners is None:
         # exact plan: one vmapped call per distinct size, whole groups —
         # no associativity assumption, best for regular key distributions

@@ -48,6 +48,13 @@ class Config:
     # O(#distinct sizes). Unclassifiable graphs always stay on the exact
     # plan (correct, but compile-heavy under pathological distributions).
     aggregate_exact_size_limit: int = 32
+    # aggregate: sort-free fast path for classified monoid graphs — the
+    # rowwise transform runs over ALL rows in one XLA call and one
+    # device segment_<op> per fetch replaces the argsort + per-size
+    # plans entirely (host argsort dominated keyed aggregation at the
+    # 10M-row TPU benchmark scale). Accumulation order differs from the
+    # exact whole-group plan (FP reassociation). Off = exact/chunk plans.
+    aggregate_segment_fast: bool = True
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).

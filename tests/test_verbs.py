@@ -840,7 +840,7 @@ class TestAggregateChunked:
         df = self._frame(sizes)
         s = self._sum_graph(df)
         exact = tfs.aggregate(s, tfs.group_by(df, "k")).to_pandas()
-        with config.override(aggregate_exact_size_limit=1):
+        with config.override(aggregate_exact_size_limit=1, aggregate_segment_fast=False):
             chunked = tfs.aggregate(s, tfs.group_by(df, "k")).to_pandas()
         exact = exact.sort_values("k").reset_index(drop=True)
         chunked = chunked.sort_values("k").reset_index(drop=True)
@@ -854,7 +854,7 @@ class TestAggregateChunked:
         m = dsl.reduce_min(
             tfs.block(df, "x", tf_name="x_input"), axes=[0]
         ).named("x")
-        with config.override(aggregate_exact_size_limit=1):
+        with config.override(aggregate_exact_size_limit=1, aggregate_segment_fast=False):
             out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
         out = out.sort_values("k").reset_index(drop=True)
         k = df["k"].values
@@ -871,7 +871,7 @@ class TestAggregateChunked:
         df = self._frame([3, 5, 7, 2])
         x_input = tfs.block(df, "x", tf_name="x_input")
         ssq = dsl.reduce_sum(x_input * x_input, axes=[0]).named("x")
-        with config.override(aggregate_exact_size_limit=1):
+        with config.override(aggregate_exact_size_limit=1, aggregate_segment_fast=False):
             out = tfs.aggregate(ssq, tfs.group_by(df, "k")).to_pandas()
         out = out.sort_values("k").reset_index(drop=True)
         k = df["k"].values
@@ -888,7 +888,7 @@ class TestAggregateChunked:
         df = self._frame(sizes)
         x_input = tfs.block(df, "x", tf_name="x_input")
         m = dsl.reduce_mean(x_input, axes=[0]).named("x")
-        with config.override(aggregate_exact_size_limit=1):
+        with config.override(aggregate_exact_size_limit=1, aggregate_segment_fast=False):
             out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
         out = out.sort_values("k").reset_index(drop=True)
         k = df["k"].values
@@ -906,7 +906,7 @@ class TestAggregateChunked:
         df = frame_of(k=keys, x=vals)
         x_input = tfs.block(df, "x", tf_name="x_input")
         m = dsl.reduce_mean(x_input, axes=[0]).named("x")
-        with config.override(aggregate_exact_size_limit=0):
+        with config.override(aggregate_exact_size_limit=0, aggregate_segment_fast=False):
             out = tfs.aggregate(m, tfs.group_by(df, "k")).to_pandas()
         out = out.sort_values("k").reset_index(drop=True)
         assert out["x"].tolist() == [2, 4]  # 6//3, 9//2 — not 1.67/4.5
@@ -922,7 +922,7 @@ class TestAggregateChunked:
         wrapped = dsl.identity(
             dsl.reduce_min(x_input, axes=[0])
         ).named("x")
-        with config.override(aggregate_exact_size_limit=1):
+        with config.override(aggregate_exact_size_limit=1, aggregate_segment_fast=False):
             out = tfs.aggregate(wrapped, tfs.group_by(df, "k")).to_pandas()
         out = out.sort_values("k").reset_index(drop=True)
         k = df["k"].values
@@ -930,6 +930,30 @@ class TestAggregateChunked:
         np.testing.assert_allclose(
             out["x"], [x[k == g].min() for g in range(2)]
         )
+
+    def test_segment_fast_path_engages_by_default(self):
+        # Default-on regression pin: a classifiable sum graph must take
+        # the sort-free segment path (one "segagg-" compile, no
+        # "vmap-agg"), or the 10M-row performance win silently vanishes.
+        from tensorframes_tpu.runtime.executor import Executor
+
+        df = self._frame([3, 5, 2])
+        s = self._sum_graph(df)
+        ex = Executor()
+        out = tfs.aggregate(s, tfs.group_by(df, "k"), executor=ex)
+        kinds = [k[0] for k in ex._cache]
+        assert any(k.startswith("segagg-") for k in kinds), kinds
+        assert "vmap-agg" not in kinds
+        k = df["k"].values
+        x = df["x"].values
+        got = dict(
+            zip(
+                np.asarray(out["k"].values).tolist(),
+                np.asarray(out["x"].values).tolist(),
+            )
+        )
+        for g in range(3):
+            np.testing.assert_allclose(got[g], x[k == g].sum(), rtol=1e-12)
 
     def test_lead_rank_constant_rejected_by_classifier(self):
         # A constant shaped (size, *cell) broadcasts along the GROUP-SIZE

@@ -56,6 +56,39 @@ class Column:
             # Dense storage: the cell shape is fully known.
             self.cell_shape = Shape(data.shape[1:])
         else:
+            # Bulk fast path: ONE np.asarray over the whole column beats
+            # a million per-cell conversions (the reference's boxed
+            # row-by-row copy loop was its recorded hot spot,
+            # `DataOps.scala:63-81`; this is the columnar answer).
+            # Truly ragged/string/object data falls through to the
+            # per-cell path below.
+            # list/tuple only: np.asarray over those always COPIES, so
+            # the frame can never alias caller memory (a pandas Series
+            # would share its buffer), and generators still reach the
+            # consuming per-cell path below.
+            bulk = None
+            if (
+                isinstance(data, (list, tuple))
+                and len(data) > 0
+                and dtype is not ScalarType.string
+            ):
+                try:
+                    bulk = np.asarray(data)
+                except (ValueError, TypeError):
+                    bulk = None
+            if (
+                bulk is not None
+                and bulk.dtype != object
+                and bulk.dtype.kind not in ("U", "S")
+                and bulk.ndim >= 1
+                and len(bulk) == len(data)
+            ):
+                target = dtype or ScalarType.from_np_dtype(bulk.dtype)
+                self.values = bulk.astype(target.np_dtype, copy=False)
+                self.ragged = None
+                self.dtype = target
+                self.cell_shape = Shape(self.values.shape[1:])
+                return
             cells = [np.asarray(x) for x in data]
             if dtype is None:
                 if not cells:
@@ -428,9 +461,13 @@ class TensorFrame:
             else:
                 host[n] = c
         names = self.columns
-        return [
-            {n: host[n].row(i) for n in names} for i in range(self.nrows)
+        # zip over the arrays directly: C-level row iteration instead of
+        # a Python row(i) call per cell
+        col_iters = [
+            host[n].values if host[n].is_dense else host[n].ragged
+            for n in names
         ]
+        return [dict(zip(names, vals)) for vals in zip(*col_iters)]
 
     def print_schema(self) -> None:
         print(self.info.explain())

@@ -43,8 +43,11 @@ def main():
     sq = dsl.reduce_sum(
         tfs.block(squared, "vsq", tf_name="vsq_input"), axes=[0]
     ).named("vsq")
-    total = np.asarray(tfs.reduce_blocks(s, squared))
-    total_sq = np.asarray(tfs.reduce_blocks(sq, squared))
+    # ONE two-fetch reduce pass: both sums come back from a single
+    # per-block program + combine (the reference needed one UDAF pass
+    # per output; a multi-fetch graph is the columnar answer)
+    res = tfs.reduce_blocks([s, sq], squared)
+    total, total_sq = np.asarray(res["v"]), np.asarray(res["vsq"])
     dt = time.perf_counter() - t0
     mean = total / rows
     var = total_sq / rows - mean**2

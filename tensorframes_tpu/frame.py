@@ -80,8 +80,6 @@ class Column:
                 bulk is not None
                 and bulk.dtype != object
                 and bulk.dtype.kind not in ("U", "S")
-                and bulk.ndim >= 1
-                and len(bulk) == len(data)
             ):
                 target = dtype or ScalarType.from_np_dtype(bulk.dtype)
                 self.values = bulk.astype(target.np_dtype, copy=False)
@@ -463,10 +461,7 @@ class TensorFrame:
         names = self.columns
         # zip over the arrays directly: C-level row iteration instead of
         # a Python row(i) call per cell
-        col_iters = [
-            host[n].values if host[n].is_dense else host[n].ragged
-            for n in names
-        ]
+        col_iters = [host[n].rows() for n in names]
         return [dict(zip(names, vals)) for vals in zip(*col_iters)]
 
     def print_schema(self) -> None:

@@ -43,6 +43,27 @@ class TestColumn:
         c = Column("s", ["ab", "cde"])
         assert c.dtype is ScalarType.string
 
+    def test_bulk_path_never_aliases_caller_memory(self):
+        # the bulk np.asarray fast path is list/tuple-only precisely so
+        # zero-copy array-likes (a pandas Series shares its buffer) go
+        # through the copying per-cell path
+        pd = pytest.importorskip("pandas")
+        s = pd.Series([1.0, 2.0, 3.0])
+        c = Column("x", s)
+        s.iloc[0] = 99.0
+        assert float(c.values[0]) == 1.0
+        assert not np.shares_memory(c.values, s.to_numpy())
+
+    def test_generator_input_consumed_once(self):
+        c = Column("x", (np.array([i, i + 1.0]) for i in range(3)))
+        assert c.is_dense and c.values.shape == (3, 2)
+
+    def test_bulk_path_dtype_coercion(self):
+        c = Column("x", [1, 2, 3], ScalarType.int32)
+        assert c.values.dtype == np.int32
+        c2 = Column("x", [1.5, 2.5])
+        assert c2.dtype is ScalarType.float64
+
 
 class TestTensorFrame:
     def test_from_dict_blocks(self):

@@ -198,11 +198,15 @@ def constant(
 
 
 def zeros(shape, dtype: ScalarType = ScalarType.float64) -> Tensor:
-    return constant(np.zeros(shape, dtype=dtype.np_dtype))
+    t = constant(np.zeros(shape, dtype=dtype.np_dtype))
+    t.name_base = "zeros"  # TF's anonymous-name base for tf.zeros
+    return t
 
 
 def ones(shape, dtype: ScalarType = ScalarType.float64) -> Tensor:
-    return constant(np.ones(shape, dtype=dtype.np_dtype))
+    t = constant(np.ones(shape, dtype=dtype.np_dtype))
+    t.name_base = "ones"
+    return t
 
 
 def fill(shape, value, dtype: Optional[ScalarType] = None) -> Tensor:
@@ -312,17 +316,43 @@ def cast(x: Tensor, dtype: ScalarType) -> Tensor:
 
 def reshape(x: Tensor, shape: Sequence[int]) -> Tensor:
     shp = constant(np.asarray(shape, dtype=np.int32))
-    return _nary("Reshape", [x, shp])
+    t = _nary(
+        "Reshape", [x, shp],
+        extra_attrs={"Tshape": AttrValue.of_type(ScalarType.int32)},
+    )
+    shp.name_relative = (t, "shape")
+    return t
 
 
 def expand_dims(x: Tensor, axis: int) -> Tensor:
-    return _nary("ExpandDims", [x, constant(np.int32(axis))])
+    dim = constant(np.int32(axis))
+    t = _nary(
+        "ExpandDims", [x, dim],
+        extra_attrs={"Tdim": AttrValue.of_type(ScalarType.int32)},
+    )
+    dim.name_relative = (t, "dim")
+    return t
 
 
 def concat(xs: Sequence[Tensor], axis: int) -> Tensor:
+    dt = xs[0].dtype
+    for x in xs[1:]:
+        if x.dtype is not dt:
+            raise ValueError(
+                f"concat: inputs disagree on dtype ({dt.name} vs "
+                f"{x.dtype.name}); cast first"
+            )
     ax = constant(np.int32(axis))
-    return _nary("ConcatV2", list(xs) + [ax], xs[0].dtype,
-                 {"N": AttrValue.of_int(len(xs))})
+    t = _nary(
+        "ConcatV2", list(xs) + [ax], dt,
+        {
+            "N": AttrValue.of_int(len(xs)),
+            "Tidx": AttrValue.of_type(ScalarType.int32),
+        },
+    )
+    t.name_base = "concat"  # TF's anonymous-name base for tf.concat
+    ax.name_relative = (t, "axis")
+    return t
 
 
 def _reducer(
@@ -361,16 +391,27 @@ def reduce_mean(x: Tensor, axes=None, keep_dims=False) -> Tensor:
     return _reducer("Mean", x, axes, keep_dims)
 
 
-def argmin(x: Tensor, axis: int = 0) -> Tensor:
-    t = _nary("ArgMin", [x, constant(np.int32(axis))], x.dtype)
+def _arg_reducer(op: str, x: Tensor, axis: int) -> Tensor:
+    """ArgMin/ArgMax with TF's `dimension` const child + index attrs."""
+    dim = constant(np.int32(axis))
+    t = _nary(
+        op, [x, dim], x.dtype,
+        {
+            "Tidx": AttrValue.of_type(ScalarType.int32),
+            "output_type": AttrValue.of_type(ScalarType.int64),
+        },
+    )
     t.dtype = ScalarType.int64
+    dim.name_relative = (t, "dimension")
     return t
+
+
+def argmin(x: Tensor, axis: int = 0) -> Tensor:
+    return _arg_reducer("ArgMin", x, axis)
 
 
 def argmax(x: Tensor, axis: int = 0) -> Tensor:
-    t = _nary("ArgMax", [x, constant(np.int32(axis))], x.dtype)
-    t.dtype = ScalarType.int64
-    return t
+    return _arg_reducer("ArgMax", x, axis)
 
 
 def unsorted_segment_sum(data: Tensor, ids: Tensor, num_segments: int) -> Tensor:

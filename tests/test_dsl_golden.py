@@ -181,6 +181,79 @@ class TestStructuralGolden:
             )
         ))
 
+    def test_zeros_ones(self):
+        z = dsl.add(dsl.zeros((2, 3)), dsl.ones((2, 3)), name="z")
+
+        def build(tf):
+            tf.add(
+                tf.zeros([2, 3], tf.float64),
+                tf.ones([2, 3], tf.float64),
+                name="z",
+            )
+
+        assert_same_graph(z, build)
+
+    def test_concat(self):
+        a = dsl.placeholder(ScalarType.float32, Shape((None, 2)), name="a")
+        b = dsl.placeholder(ScalarType.float32, Shape((None, 3)), name="b")
+        z = dsl.concat([a, b], axis=1)
+        assert_same_graph(dsl.identity(z).named("out"), lambda tf: (
+            tf.identity(
+                tf.concat(
+                    [
+                        tf.placeholder(tf.float32, [None, 2], name="a"),
+                        tf.placeholder(tf.float32, [None, 3], name="b"),
+                    ],
+                    axis=1,
+                ),
+                name="out",
+            )
+        ))
+
+    def test_reshape(self):
+        x = dsl.placeholder(ScalarType.float32, Shape((6,)), name="x")
+        z = dsl.reshape(x, (2, 3))
+        assert_same_graph(dsl.identity(z).named("out"), lambda tf: (
+            tf.identity(
+                tf.reshape(
+                    tf.placeholder(tf.float32, [6], name="x"), [2, 3]
+                ),
+                name="out",
+            )
+        ))
+
+    def test_expand_dims(self):
+        x = dsl.placeholder(ScalarType.float32, Shape((4,)), name="x")
+        z = dsl.expand_dims(x, 0)
+        assert_same_graph(dsl.identity(z).named("out"), lambda tf: (
+            tf.identity(
+                tf.expand_dims(
+                    tf.placeholder(tf.float32, [4], name="x"), 0
+                ),
+                name="out",
+            )
+        ))
+
+    def test_argmin(self):
+        x = dsl.placeholder(ScalarType.float32, Shape((4,)), name="x")
+        z = dsl.argmin(x, axis=0)
+        assert_same_graph(dsl.identity(z).named("out"), lambda tf: (
+            tf.identity(
+                tf.argmin(tf.placeholder(tf.float32, [4], name="x"), 0),
+                name="out",
+            )
+        ))
+
+    def test_unary_chain(self):
+        x = dsl.placeholder(ScalarType.float32, Shape((None,)), name="x")
+        z = dsl.sqrt(dsl.square(x))
+        assert_same_graph(dsl.identity(z).named("out"), lambda tf: (
+            tf.identity(
+                tf.sqrt(tf.square(tf.placeholder(tf.float32, [None], name="x"))),
+                name="out",
+            )
+        ))
+
     def test_matmul(self):
         a = dsl.placeholder(ScalarType.float32, Shape((None, 4)), name="a")
         b = dsl.placeholder(ScalarType.float32, Shape((4, 2)), name="b")

@@ -1632,12 +1632,15 @@ def aggregate(
     # one structural classification serves the segment fast path AND the
     # chunked plan's eligibility check below
     classified = _chunk_combiners(graph, fetch_list, summary)
+    from .utils.profiling import count as _count
+
     if (
         _config.get().aggregate_segment_fast
         and frame.nrows > 0
         and classified is not None
     ):
         # sort-free: one XLA call over all rows + device segment ops
+        _count("aggregate.plan.segment")
         return _aggregate_segment(
             ex, graph, fetch_list, classified, feed_names, mapping, grouped
         )
@@ -1664,6 +1667,9 @@ def aggregate(
         # only chunk when the graph is provably chunk-safe; otherwise the
         # exact plan keeps correctness at the cost of more compiles
         combiners = classified
+    _count(
+        "aggregate.plan.exact" if combiners is None else "aggregate.plan.chunk"
+    )
     if combiners is None:
         # exact plan: one vmapped call per distinct size, whole groups —
         # no associativity assumption, best for regular key distributions

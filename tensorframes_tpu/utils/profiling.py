@@ -22,7 +22,7 @@ import time
 from collections import defaultdict
 from typing import Dict
 
-__all__ = ["trace", "annotate", "record", "stats", "reset_stats"]
+__all__ = ["trace", "annotate", "record", "count", "stats", "reset_stats"]
 
 
 class ExecStats:
@@ -53,6 +53,11 @@ def stats() -> Dict[str, float]:
 
 def reset_stats() -> None:
     _stats.reset()
+
+
+def count(key: str, value: float = 1.0) -> None:
+    """Bump a named counter (e.g. which aggregate plan engaged)."""
+    _stats.add(key, value)
 
 
 @contextlib.contextmanager

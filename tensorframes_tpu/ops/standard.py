@@ -399,7 +399,7 @@ def _concat_v2(ctx, node, inputs):
     return jnp.concatenate([jnp.asarray(x) for x in inputs[:-1]], axis=axis)
 
 
-@register("Pack")
+@register("Pack", "Stack")  # "Stack" is the legacy TF 1.x alias
 def _pack(ctx, node, inputs):
     return jnp.stack([jnp.asarray(x) for x in inputs], axis=int(node.attr("axis", 0)))
 
@@ -417,6 +417,79 @@ def _split(ctx, node, inputs):
     axis = int(ctx.static(inputs[0], node, "split_dim"))
     num = int(node.attr("num_split", 1))
     return tuple(jnp.split(jnp.asarray(inputs[1]), num, axis=axis))
+
+
+@register("SplitV")
+def _split_v(ctx, node, inputs):
+    sizes = ctx.static_int_list(inputs[1], node, "size_splits")
+    axis = int(ctx.static(inputs[2], node, "split_dim"))
+    x = jnp.asarray(inputs[0])
+    if -1 in sizes:  # one size may be inferred from the remainder
+        known = sum(s for s in sizes if s >= 0)
+        sizes = [s if s >= 0 else x.shape[axis] - known for s in sizes]
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, bounds, axis=axis))
+
+
+@register("LeakyRelu")
+def _leaky_relu(ctx, node, inputs):
+    import jax
+
+    alpha = float(node.attr("alpha", 0.2))
+    return jax.nn.leaky_relu(jnp.asarray(inputs[0]), negative_slope=alpha)
+
+
+@register("GatherNd")
+def _gather_nd(ctx, node, inputs):
+    params = jnp.asarray(inputs[0])
+    indices = jnp.asarray(inputs[1])
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return params[idx]
+
+
+@register("ScatterNd")
+def _scatter_nd(ctx, node, inputs):
+    indices = jnp.asarray(inputs[0])
+    updates = jnp.asarray(inputs[1])
+    shape = tuple(ctx.static_int_list(inputs[2], node, "shape"))
+    out = jnp.zeros(shape, updates.dtype)
+    idx = tuple(jnp.moveaxis(indices, -1, 0))
+    return out.at[idx].add(updates)
+
+
+@register("ResizeBilinear")
+def _resize_bilinear(ctx, node, inputs):
+    """TF1 bilinear resize with its exact coordinate conventions:
+    legacy asymmetric (default), align_corners, or half_pixel_centers —
+    jax.image.resize only offers half-pixel, so interpolate directly.
+    Output is always float32 (TF's contract for any input dtype)."""
+    x = jnp.asarray(inputs[0]).astype(jnp.float32)  # NHWC
+    out_h, out_w = (int(v) for v in ctx.static_int_list(inputs[1], node, "size"))
+    in_h, in_w = x.shape[1], x.shape[2]
+    align = bool(node.attr("align_corners", False))
+    half_pixel = bool(node.attr("half_pixel_centers", False))
+
+    def src(out_n, in_n):
+        o = jnp.arange(out_n, dtype=jnp.float32)
+        if align and out_n > 1:
+            return o * ((in_n - 1) / (out_n - 1))
+        if half_pixel:
+            return jnp.maximum((o + 0.5) * (in_n / out_n) - 0.5, 0.0)
+        return o * (in_n / out_n)
+
+    def lerp_axis(arr, coords, in_n, axis):
+        lo = jnp.clip(jnp.floor(coords).astype(jnp.int32), 0, in_n - 1)
+        hi = jnp.minimum(lo + 1, in_n - 1)
+        w = (coords - lo).astype(arr.dtype)
+        shape = [1] * arr.ndim
+        shape[axis] = w.shape[0]
+        w = w.reshape(shape)
+        a = jnp.take(arr, lo, axis=axis)
+        b = jnp.take(arr, hi, axis=axis)
+        return a * (1 - w) + b * w
+
+    out = lerp_axis(x, src(out_h, in_h), in_h, axis=1)
+    return lerp_axis(out, src(out_w, in_w), in_w, axis=2)
 
 
 @register("Slice")

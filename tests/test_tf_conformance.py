@@ -439,3 +439,128 @@ class TestExtendedOpParity:
             {"x": np.random.RandomState(7).rand(1, 2, 2, 8).astype(np.float32)},
             "z", rtol=1e-4,
         )
+
+
+class TestRound3OpParity:
+    """Conformance for ops added in round 3: SplitV, LeakyRelu, GatherNd,
+    ScatterNd, ResizeBilinear (plus the Stack alias of Pack)."""
+
+    def test_split_v_with_inferred_size(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None, 5], name="x")
+            a, b = tf.split(x, [2, -1], axis=1, name="sp")
+            tf.identity(b, name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(10, dtype=np.float32).reshape(2, 5)},
+            "z",
+        )
+
+    def test_leaky_relu(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [None], name="x")
+            tf.nn.leaky_relu(x, alpha=0.3, name="z")
+
+        assert_match(
+            build,
+            {"x": np.array([-2.0, -0.5, 0.0, 1.5], np.float32)},
+            "z",
+        )
+
+    def test_gather_nd(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [3, 4], name="x")
+            idx = tf.constant(np.array([[0, 1], [2, 3]], np.int32))
+            tf.gather_nd(x, idx, name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(12, dtype=np.float32).reshape(3, 4)},
+            "z",
+        )
+
+    def test_scatter_nd(self):
+        def build(tf):
+            u = tf.placeholder(tf.float32, [2], name="u")
+            idx = tf.constant(np.array([[1], [3]], np.int32))
+            shape = tf.constant(np.array([5], np.int32))
+            tf.scatter_nd(idx, u, shape, name="z")
+
+        assert_match(
+            build,
+            {"u": np.array([9.0, 7.0], np.float32)},
+            "z",
+        )
+
+    def test_resize_bilinear(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [1, 2, 2, 1], name="x")
+            tf.image.resize_bilinear(x, [4, 4], name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(4, dtype=np.float32).reshape(1, 2, 2, 1)},
+            "z",
+            rtol=1e-5,
+        )
+
+    def test_resize_bilinear_align_corners(self):
+        def build(tf):
+            x = tf.placeholder(tf.float32, [1, 3, 3, 1], name="x")
+            tf.image.resize_bilinear(x, [5, 5], align_corners=True, name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(9, dtype=np.float32).reshape(1, 3, 3, 1)},
+            "z",
+            rtol=1e-5,
+        )
+
+    def test_stack_alias_via_pack(self):
+        # modern tf.stack emits Pack; the legacy "Stack" op name only
+        # appears in old frozen graphs, so build that NodeDef by hand
+        def build(tf):
+            x = tf.placeholder(tf.float32, [2], name="x")
+            tf.stack([x, x * 2.0], axis=0, name="z")
+
+        assert_match(
+            build, {"x": np.array([1.0, 2.0], np.float32)}, "z"
+        )
+
+    def test_legacy_stack_op_name(self):
+        from tensorframes_tpu.graph.ir import Graph, GraphNode
+        from tensorframes_tpu.proto.graphdef import AttrValue
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        g = Graph()
+        f32 = AttrValue.of_type(ScalarType.float32)
+        g.add(
+            GraphNode(
+                "x", "Placeholder", [],
+                {"dtype": f32, "shape": AttrValue.of_shape(Shape((2,)))},
+            )
+        )
+        g.add(
+            GraphNode(
+                "z", "Stack", ["x", "x"],
+                {"T": f32, "N": AttrValue.of_int(2), "axis": AttrValue.of_int(0)},
+            )
+        )
+        fn = build_callable(g, ["z"], ["x"])
+        (out,) = fn(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(out), np.array([[1.0, 2.0], [1.0, 2.0]], np.float32)
+        )
+
+    def test_resize_bilinear_int_input_outputs_float32(self):
+        def build(tf):
+            x = tf.placeholder(tf.int32, [1, 2, 2, 1], name="x")
+            tf.image.resize_bilinear(x, [4, 4], name="z")
+
+        assert_match(
+            build,
+            {"x": np.arange(4, dtype=np.int32).reshape(1, 2, 2, 1)},
+            "z",
+            rtol=1e-5,
+        )

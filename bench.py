@@ -296,8 +296,12 @@ def main():
         try:
             with open(base_path) as f:
                 base = json.load(f)
-            if base.get("value"):
-                vs = rows_per_sec / float(base["value"])
+            # like-for-like: compare only against a baseline recorded on
+            # the SAME platform (round-2 weakness: two fallback rounds
+            # reported a CPU/TPU ratio); unknown platforms get null
+            key = {"tpu": "value", "cpu": "cpu_value"}.get(dev.platform)
+            if key and base.get(key):
+                vs = rows_per_sec / float(base[key])
         except Exception:
             pass
 

@@ -275,7 +275,10 @@ def main():
 
     is_tpu = dev.platform == "tpu"
     n = int(os.environ.get("BENCH_ROWS", 200_000_000 if is_tpu else 10_000_000))
-    iters = int(os.environ.get("BENCH_ITERS", 10))
+    # enough chained iterations that per-dispatch overhead amortizes out
+    # of the steady-state rate (each TPU iteration is ~10ms of device
+    # work; 30 of them keep the whole chain under a second)
+    iters = int(os.environ.get("BENCH_ITERS", 30 if is_tpu else 10))
 
     rows_per_sec = _bench_x3_chain(tfs, jax, n, iters)
     # x+3 moves one f32 read + one f32 write per row per iteration

@@ -25,7 +25,7 @@ import numpy as np
 
 from ..graph.ir import Graph
 from ..ops.lowering import build_callable
-from .pjrt_host import PjrtHost, stablehlo_for
+from .pjrt_host import PjrtHost
 
 __all__ = ["NativeExecutor"]
 
@@ -75,12 +75,18 @@ class NativeExecutor:
                     in_tree,
                     [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat_in],
                 )
-                out_shape = jax.eval_shape(traceable, *structs)
-                out_flat, out_tree = jax.tree_util.tree_flatten(out_shape)
+                # keep_unused: without it jit DCEs unused arguments out
+                # of the module's parameter list and execution fails
+                # with a buffer-count mismatch (e.g. the segment
+                # aggregate's counts input when no fetch is a Mean)
+                lowered = jax.jit(traceable, keep_unused=True).lower(*structs)
+                out_flat, out_tree = jax.tree_util.tree_flatten(
+                    lowered.out_info
+                )
                 out_specs = [
                     (tuple(o.shape), np.dtype(o.dtype)) for o in out_flat
                 ]
-                mlir = stablehlo_for(traceable, *structs)
+                mlir = str(lowered.compiler_ir(dialect="stablehlo"))
                 exe = self.host.compile(mlir)
                 self.compile_count += 1
                 entry = (exe, out_specs, out_tree)

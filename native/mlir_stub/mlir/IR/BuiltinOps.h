@@ -1,0 +1,20 @@
+// Minimal stand-in for mlir/IR/BuiltinOps.h, used when compiling
+// native/pjrt_cpu_plugin.cc against the TensorFlow wheel's headers: the
+// wheel ships xla/pjrt headers that #include this file but ships no
+// LLVM/MLIR headers. The xla headers we use only mention mlir::ModuleOp
+// opaquely, passing it BY VALUE to two virtual PjRtClient overloads we
+// never call. The real ModuleOp is a trivially-copyable single-pointer
+// wrapper (mlir::OpState holds one Operation*), so this stub is
+// layout-compatible for those signatures; nothing here is ever
+// constructed or dereferenced.
+#ifndef TFS_NATIVE_MLIR_STUB_BUILTIN_OPS_H_
+#define TFS_NATIVE_MLIR_STUB_BUILTIN_OPS_H_
+namespace mlir {
+class Operation;
+class ModuleOp {
+ public:
+  ModuleOp() = default;
+  Operation* op_ = nullptr;
+};
+}  // namespace mlir
+#endif  // TFS_NATIVE_MLIR_STUB_BUILTIN_OPS_H_

@@ -30,6 +30,7 @@ from ..native import _find_lib
 __all__ = [
     "PjrtHost",
     "NativeExecutable",
+    "cpu_plugin_path",
     "default_plugin_path",
     "probe_plugin",
     "stablehlo_for",
@@ -87,13 +88,28 @@ def _pjrt_type(dt: np.dtype) -> int:
     return t
 
 
+def cpu_plugin_path() -> Optional[str]:
+    """The repo-built CPU PJRT plugin (native/libtfs_pjrt_cpu.so), if built.
+
+    A dlopen-able CPU plugin backed by the TF wheel's XLA CPU client
+    (native/pjrt_cpu_plugin.cc); needs no device claim and no health
+    probe, so native-host tests run everywhere regardless of chip state.
+    """
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    p = os.path.join(root, "native", "libtfs_pjrt_cpu.so")
+    return p if os.path.exists(p) else None
+
+
 def default_plugin_path() -> Optional[str]:
     """Locate a PJRT C-API plugin .so.
 
-    Search order: ``TFS_PJRT_PLUGIN`` env var, installed ``jax_plugins``
-    namespace packages (the official plugin distribution channel —
-    jaxlib itself ships NO dlopen-able CPU plugin; its CPU client is
-    statically linked), then known machine-local plugin locations.
+    Search order: ``TFS_PJRT_PLUGIN`` env var, machine-local accelerator
+    plugins, installed ``jax_plugins`` namespace packages (the official
+    plugin distribution channel — jaxlib itself ships NO dlopen-able CPU
+    plugin; its CPU client is statically linked), then the repo-built
+    CPU plugin (`cpu_plugin_path`) as the accelerator-less fallback.
     """
     env = os.environ.get("TFS_PJRT_PLUGIN")
     if env and os.path.exists(env):
@@ -125,7 +141,7 @@ def default_plugin_path() -> Optional[str]:
                 return hits[0]
     except Exception:
         pass
-    return None
+    return cpu_plugin_path()
 
 
 def probe_plugin(path: str, timeout_s: float = 60.0) -> bool:

@@ -70,9 +70,9 @@ def _stale_claimant_pids(reap_all: bool = False) -> list:
     chip for every later process). "Stale" means orphaned (reparented to
     init): a healthy job merely keeping the chip busy still has its
     parent and is never touched. ``reap_all`` (or ``BENCH_REAP=all``)
-    widens to every other holder — for operators who know the machine
-    is theirs alone, and for the acquire loop's FINAL attempt on a hung
-    probe (opt out of that escalation with ``BENCH_REAP=never``)."""
+    widens to every other holder — opt-in only, for operators who know
+    the machine is theirs alone (``BENCH_REAP=escalate`` limits the
+    widening to the acquire loop's final attempt on a hung probe)."""
     me = os.getpid()
     ppid = os.getppid()
     reap_all = reap_all or os.environ.get("BENCH_REAP") == "all"
@@ -97,11 +97,25 @@ def _stale_claimant_pids(reap_all: bool = False) -> list:
     return pids
 
 
+def _cmdline(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(errors="replace").strip()
+    except OSError:
+        return "<gone>"
+
+
 def _reap_stale_claimants(reap_all: bool = False) -> int:
     """SIGTERM (never SIGKILL — force-killing mid-claim is what leaks
-    grants in the first place) stale plugin holders, with a grace wait."""
+    grants in the first place) stale plugin holders, with a grace wait.
+    Victims are logged (pid + cmdline) BEFORE the signal so operators on
+    shared machines can audit what was killed."""
     pids = _stale_claimant_pids(reap_all)
     for pid in pids:
+        print(
+            f"# reaping device holder pid={pid} cmdline={_cmdline(pid)!r}",
+            file=sys.stderr,
+        )
         try:
             os.kill(pid, signal.SIGTERM)
         except OSError:
@@ -146,13 +160,12 @@ def _probe(timeout_s: float):
 
 def _acquire_accelerator():
     """Probe-with-recovery loop: reap stale claimants between attempts,
-    back off, retry — not one try then CPU. The FINAL attempt widens
-    reaping to every device holder (``BENCH_REAP=all`` semantics) as a
-    last resort before surrendering to CPU — but only when the probe
-    HANGS (a wedge reaping can fix; an init error cannot be reaped
-    away) and not when ``BENCH_REAP=never`` protects co-tenant jobs.
-    Returns ``(ok, fallback_reason, stderr_tail)``; on success the
-    latter two are None."""
+    back off, retry — not one try then CPU. With ``BENCH_REAP=all`` or
+    ``BENCH_REAP=escalate`` the FINAL attempt widens reaping to every
+    device holder as a last resort before surrendering to CPU — opt-in,
+    and only when the probe HANGS (a wedge reaping can fix; an init
+    error cannot be reaped away). Returns ``(ok, fallback_reason,
+    stderr_tail)``; on success the latter two are None."""
     probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
     attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
     backoff = 30.0
@@ -162,12 +175,14 @@ def _acquire_accelerator():
         status, tail = _probe(probe_s)
         if status == "ok":
             return True, None, None
-        # last resort before CPU fallback: widen to non-orphaned holders,
-        # unless the operator opted out or the failure isn't a wedge
+        # last resort before CPU fallback: widening to non-orphaned
+        # holders is OPT-IN (BENCH_REAP=all reaps every attempt;
+        # BENCH_REAP=escalate only on the final hung probe) — never the
+        # default, because the victims may be healthy co-tenant jobs
         reap_all = (
             attempt == attempts - 1
             and status == "hang"
-            and os.environ.get("BENCH_REAP") != "never"
+            and os.environ.get("BENCH_REAP") in ("all", "escalate")
         )
         reaped = _reap_stale_claimants(reap_all)
         print(

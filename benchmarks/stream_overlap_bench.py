@@ -19,8 +19,8 @@ variant (producer sleeps per chunk, so ingest dominates) checks the
 efficiency holds when the bottleneck flips.
 
 Sizes: OVERLAP_CHUNK_ROWS (16M), OVERLAP_CHUNKS (32) — 2 GB of f32 at
-the defaults. OVERLAP_THROTTLE_S (0.05) per-chunk sleep for the
-throttled variant.
+the defaults. OVERLAP_THROTTLE_MS (50, milliseconds) per-chunk sleep
+for the throttled variant.
 """
 
 from __future__ import annotations

@@ -1056,7 +1056,7 @@ def reduce_blocks_stream(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
-    fold_every: Optional[int] = 64,
+    fold_every="auto",
 ):
     """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
     hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
@@ -1075,11 +1075,21 @@ def reduce_blocks_stream(
     reference's pairwise partial combine (`reducePairBlock`,
     `DebugRowOps.scala:748-757`). A non-associative graph (e.g. Mean:
     a fold result re-enters the next combine weighted as ONE chunk) is
-    not exact under tree-folding; pass ``fold_every=None`` to keep every
-    chunk partial for a single equally-weighted final combine at the
-    cost of O(#chunks) host memory.
+    not exact under tree-folding, so the default ``fold_every="auto"``
+    enables tree-folding (every 64 chunks) ONLY when every fetch is an
+    associative monoid reduce (sum/min/max/prod) consuming its
+    placeholder DIRECTLY — partials recombine through the same graph,
+    so any transform between placeholder and reduce (``Sum(x*x)``)
+    would be re-applied to the partials at each fold. Mean,
+    transform-then-reduce, and unclassifiable graphs fall back to the
+    single equally-weighted final combine at the cost of O(#chunks)
+    host memory. Pass an int to force a fold cadence, or ``None`` to
+    force the single final combine.
     """
     graph, fetch_list = _as_graph(fetches, fetch_names)
+    auto_fold = fold_every == "auto"
+    if auto_fold:
+        fold_every = None  # resolved from the first chunk's analysis below
     if fold_every is not None:
         fold_every = max(2, int(fold_every))
 
@@ -1097,6 +1107,24 @@ def reduce_blocks_stream(
 
     partials: List[Dict] = []
     for f in _prefetch_iter(frames):
+        if auto_fold:
+            # classify once, on the first chunk: tree-fold only graphs
+            # proven associative (sum/min/max/prod monoids); anything
+            # else keeps every partial for one exact final combine
+            auto_fold = False
+            try:
+                ov = _ph_overrides(graph, f, feed_dict, block_level=True)
+                s = analyze_graph(graph, fetch_list, placeholder_shapes=ov)
+                # require_direct: partials recombine through the same
+                # graph here, so an interposed transform (Sum(x*x))
+                # would be re-applied at every fold
+                comb = _chunk_combiners(
+                    graph, fetch_list, s, require_direct=True
+                )
+                if comb is not None and "mean" not in comb.values():
+                    fold_every = 64
+            except Exception:
+                pass  # conservative: no folding when classification fails
         r = reduce_blocks(
             graph, f, feed_dict, fetch_names=fetch_list,
             executor=executor, mesh=mesh,
@@ -1317,7 +1345,8 @@ _ROWWISE_OPS = {
 
 
 def _chunk_combiners(
-    graph: Graph, fetch_list: List[str], summary: GraphSummary
+    graph: Graph, fetch_list: List[str], summary: GraphSummary,
+    require_direct: bool = False,
 ) -> Optional[Dict[str, str]]:
     """Classify each fetch as ``Reduce(rowwise(placeholder), axis=0)``.
 
@@ -1328,6 +1357,12 @@ def _chunk_combiners(
     Returns None otherwise; callers then use the exact whole-group plan.
     Structural, so transform-then-reduce graphs like ``Sum(x*x)`` chunk
     correctly and unclassifiable graphs are never silently wrong.
+
+    ``require_direct`` additionally demands each reduce consume its
+    placeholder DIRECTLY (no transform in between) — the stricter class
+    for callers that recombine partials through the same graph (e.g.
+    `reduce_blocks_stream` tree-folding), where an interposed transform
+    would be re-applied to the partials.
     """
     out: Dict[str, str] = {}
     for f in fetch_list:
@@ -1348,6 +1383,10 @@ def _chunk_combiners(
             return None
         data_in = node.data_inputs()
         if len(data_in) != 2:
+            return None
+        if require_direct and graph[data_in[0][0]].op not in (
+            "Placeholder", "PlaceholderV2"
+        ):
             return None
         idx_node = graph[data_in[1][0]]
         if idx_node.op != "Const":
@@ -1396,6 +1435,24 @@ def _chunk_combiners(
                 return None
         out[_base(f)] = _CHUNK_COMBINERS[node.op]
     return out
+
+
+def _gid_dtype(num_keys: int):
+    """Group-id dtype for the segment paths (host AND mesh — the mesh
+    path aliases this, `parallel/verbs.py`). int32 silently wraps past
+    2^31-1 DISTINCT KEYS — within 2x of the 1B+-row regime the north
+    star targets — so widen to int64 at the cliff. JAX without x64 mode
+    would silently downcast int64 ids back to int32, so that
+    configuration is refused loudly instead."""
+    if num_keys <= np.iinfo(np.int32).max:
+        return np.int32
+    if not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            f"aggregate: {num_keys} distinct keys overflows int32 group "
+            "ids and jax x64 is disabled (int64 ids would be silently "
+            "truncated); enable jax_enable_x64 for this key cardinality"
+        )
+    return np.int64
 
 
 def _aggregate_segment(
@@ -1459,7 +1516,7 @@ def _aggregate_segment(
     sfn = ex.cached(
         f"segagg-{num_groups}-{comb_sig}", graph, fetch_list, feed_names, make
     )
-    gid = inverse.astype(np.int32 if num_groups <= 2**31 - 1 else np.int64)
+    gid = inverse.astype(_gid_dtype(num_groups))
     # counts ride as exact int32 and convert to the fetch dtype in-graph;
     # the O(n) bincount is skipped entirely when no fetch is a Mean
     counts = (

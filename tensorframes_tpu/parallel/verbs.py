@@ -362,21 +362,8 @@ def reduce_rows(
 # ---------------------------------------------------------------------------
 
 
-def _gid_dtype(num_keys: int):
-    """Group-id dtype for the mesh segment-sum path. int32 silently
-    wraps past 2^31-1 DISTINCT KEYS — within 2x of the 1B+-row regime
-    the north star targets — so widen to int64 at the cliff. JAX
-    without x64 mode would silently downcast int64 ids back to int32,
-    so that configuration is refused loudly instead."""
-    if num_keys <= np.iinfo(np.int32).max:
-        return np.int32
-    if not jax.config.read("jax_enable_x64"):
-        raise ValueError(
-            f"aggregate: {num_keys} distinct keys overflows int32 group "
-            "ids and jax x64 is disabled (int64 ids would be silently "
-            "truncated); enable jax_enable_x64 for this key cardinality"
-        )
-    return np.int64
+# Shared with the host segment path so both overflow the same way.
+_gid_dtype = _api._gid_dtype
 
 
 def aggregate(

@@ -488,6 +488,97 @@ class TestReduceBlocksStream:
         assert len(folds) >= 2
         assert max(folds) <= 4
 
+    def test_auto_fold_engages_for_sum(self, monkeypatch):
+        # Default fold policy: associative monoid fetches (Sum) are
+        # tree-folded without the caller passing fold_every.
+        from tensorframes_tpu import api as _api
+
+        leads = []
+        real_reduce_blocks = _api.reduce_blocks
+
+        def spy(graph, frame, feed_dict=None, **kw):
+            leads.append(frame.nrows)
+            return real_reduce_blocks(graph, frame, feed_dict, **kw)
+
+        monkeypatch.setattr(_api, "reduce_blocks", spy)
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.full(3, float(i))})
+            for i in range(70)
+        ]
+        x_input = tfs.block(chunks[0], "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        total = tfs.reduce_blocks_stream(s, iter(chunks))
+        assert float(total) == 3 * sum(range(70))
+        # 70 chunks with the auto cadence of 64: at least one fold call
+        # over the partial table (lead = 64) before the final combine
+        assert 64 in leads
+
+    def test_auto_fold_disabled_for_mean(self, monkeypatch):
+        # ADVICE r3: Mean partials re-entering a fold weighted as one
+        # chunk would skew the result once the stream exceeds the fold
+        # cadence. The auto policy must keep ALL chunk partials for a
+        # single equally-weighted final combine — exact for equal-sized
+        # chunks, like the reference's pairwise combine contract.
+        from tensorframes_tpu import api as _api
+
+        leads = []
+        real_reduce_blocks = _api.reduce_blocks
+
+        def spy(graph, frame, feed_dict=None, **kw):
+            leads.append(frame.nrows)
+            return real_reduce_blocks(graph, frame, feed_dict, **kw)
+
+        monkeypatch.setattr(_api, "reduce_blocks", spy)
+        n_chunks = 70  # > the 64-chunk auto cadence
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.full(3, float(i))})
+            for i in range(n_chunks)
+        ]
+        x_input = tfs.block(chunks[0], "x", tf_name="x_input")
+        m = dsl.reduce_mean(x_input, axes=[0]).named("x")
+        total = tfs.reduce_blocks_stream(m, iter(chunks))
+        # exact: mean of per-chunk means over equal-sized chunks
+        assert float(total) == pytest.approx(np.mean(range(n_chunks)))
+        # no intermediate fold: the only non-3-row call is the final
+        # combine over all 70 partials
+        folds = [n for n in leads if n != 3]
+        assert folds == [n_chunks]
+
+    def test_auto_fold_disabled_for_transform_then_reduce(self, monkeypatch):
+        # code-review r4: Sum(x*x) classifies as a "sum" monoid for the
+        # chunk plan, but stream partials recombine through the SAME
+        # graph — a fold would square the partial sums. The auto gate
+        # must require the reduce to consume its placeholder directly.
+        from tensorframes_tpu import api as _api
+
+        leads = []
+        real_reduce_blocks = _api.reduce_blocks
+
+        def spy(graph, frame, feed_dict=None, **kw):
+            leads.append(frame.nrows)
+            return real_reduce_blocks(graph, frame, feed_dict, **kw)
+
+        monkeypatch.setattr(_api, "reduce_blocks", spy)
+        n_chunks = 70
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.full(3, float(i))})
+            for i in range(n_chunks)
+        ]
+        x_input = tfs.block(chunks[0], "x", tf_name="x_input")
+        sq = dsl.reduce_sum((x_input * x_input), axes=[0]).named("x")
+        total = tfs.reduce_blocks_stream(sq, iter(chunks))
+        folds = [n for n in leads if n != 3]
+        assert folds == [n_chunks]  # single final combine, no tree fold
+        # (the final combine still re-squares partials — that is the
+        # documented same-graph combine contract, unchanged from the
+        # reference's reducePairBlock; what matters is folding never
+        # compounds it)
+        # chunk i partial = sum(i^2 over 3 rows) = 3i^2; the final
+        # same-graph combine computes sum((3i^2)^2)
+        assert float(total) == float(
+            np.sum(np.array([3 * i * i for i in range(n_chunks)], float) ** 2)
+        )
+
 
 class TestBindings:
     """Per-call bound placeholders: jit arguments, not baked constants."""

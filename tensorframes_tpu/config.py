@@ -55,6 +55,10 @@ class Config:
     # 10M-row TPU benchmark scale). Accumulation order differs from the
     # exact whole-group plan (FP reassociation). Off = exact/chunk plans.
     aggregate_segment_fast: bool = True
+    # Executor compile-cache bound (LRU): long-lived services whose
+    # graphs / shapes drift would otherwise accumulate compiled
+    # executables forever (the cache is never cleared implicitly).
+    executor_cache_entries: int = 512
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).

@@ -55,6 +55,22 @@ def _base(name: str) -> str:
     return parse_edge(name)[0]
 
 
+def _mesh_sig(mesh: Mesh) -> str:
+    """Cache-key signature of a mesh's concrete device identity. A
+    cached shard_map program is bound to the devices it was traced
+    over; two meshes with the same device COUNT but different devices
+    (or a different topology) must never share an executor-cache entry,
+    or the reused program would run on the old mesh's chips."""
+    shape = "x".join(str(int(n)) for n in mesh.devices.shape)
+    # device ids are unique only per backend: cpu:0 and tpu:0 are both
+    # id 0, so the platform must disambiguate (virtual-CPU dry run
+    # followed by a real TPU run in one process must not share entries)
+    ids = ",".join(
+        f"{getattr(d, 'platform', '?')}:{int(d.id)}" for d in mesh.devices.flat
+    )
+    return f"{shape}@{ids}"
+
+
 def _split(frame: TensorFrame, cols: Sequence[str], ndev: int):
     """(main arrays with lead = s*ndev, tail arrays with lead = r)."""
     n = frame.nrows
@@ -129,7 +145,7 @@ def map_blocks(
         # specs shard/replicate the wrong arguments.
         spec_sig = ";".join(str(s) for s in in_specs)
         sharded = ex.cached(
-            f"shmap-{ndev}-[{spec_sig}]",
+            f"shmap-{_mesh_sig(mesh)}-[{spec_sig}]",
             graph,
             fetch_list,
             feed_names,
@@ -229,7 +245,7 @@ def reduce_blocks(
             P("data", *([None] * (main[c].ndim - 1))) for c in cols_used
         )
         sharded = ex.cached(
-            f"shred-{ndev}",
+            f"shred-{_mesh_sig(mesh)}",
             graph,
             fetch_list,
             feed_names,
@@ -307,9 +323,15 @@ def reduce_rows(
         return carry
 
     partials: List[Tuple[np.ndarray, ...]] = []
-    if s > 1 or (s == 1 and ndev > 0):
+    if s >= 1 and ndev > 0:
         def shard_fold(*cols):
-            local = fold_rows(cols) if s > 1 else tuple(c[0] for c in cols)
+            # fold_rows handles s == 1 too (zero-length scan returns the
+            # carry unchanged) — no size-dependent branch may live in
+            # this closure, because the compiled fn is CACHED by
+            # (graph, ndev) and a branch captured at first trace would
+            # silently misapply to later calls with a different shard
+            # size
+            local = fold_rows(cols)
             gathered = tuple(
                 lax.all_gather(p, "data", axis=0, tiled=False) for p in local
             )
@@ -319,7 +341,7 @@ def reduce_rows(
             P("data", *([None] * (main[c].ndim - 1))) for c in cols_used
         )
         sharded = ex.cached(
-            f"shfold-{ndev}",
+            f"shfold-{_mesh_sig(mesh)}",
             graph,
             fetch_list,
             feed_names,
@@ -335,23 +357,35 @@ def reduce_rows(
         )
         outs = sharded(*[main[c] for c in cols_used])
         partials.append(tuple(np.asarray(o) for o in outs))
+
+    # tail folds + partial combine share ONE cached program (jit
+    # re-specializes per lead dim) instead of building a fresh
+    # jax.jit closure per call (round-3 verdict: every other mesh
+    # program was cached; these two leaked a compile per invocation)
+    def _jfold():
+        return ex.cached(
+            "jfold",
+            graph,
+            fetch_list,
+            feed_names,
+            lambda: jax.jit(lambda *cols: fold_rows(cols)),
+        )
+
     if cols_used and tail[cols_used[0]].shape[0] > 0:
-        jfold = jax.jit(lambda *cols: fold_rows(cols))
         t = [tail[c] for c in cols_used]
         if t[0].shape[0] == 1:
             partials.append(tuple(np.asarray(x[0]) for x in t))
         else:
-            partials.append(tuple(np.asarray(o) for o in jfold(*t)))
+            partials.append(tuple(np.asarray(o) for o in _jfold()(*t)))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
     if len(partials) == 1:
         final = partials[0]
     else:
-        jfold = jax.jit(lambda *cols: fold_rows(cols))
         stacked = [
             np.stack([p[i] for p in partials]) for i in range(len(bases))
         ]
-        final = tuple(np.asarray(o) for o in jfold(*stacked))
+        final = tuple(np.asarray(o) for o in _jfold()(*stacked))
     if len(bases) == 1:
         return final[0]
     return dict(zip(bases, final))
@@ -385,6 +419,7 @@ def aggregate(
     (`_aggregate_mesh_general`); anything else falls back to the host
     exact plan.
     """
+    ex = executor or default_executor()
     frame = grouped.frame
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
     if not _all_fetches_are_lead_sums(graph, fetch_list):
@@ -411,10 +446,18 @@ def aggregate(
     n = frame.nrows
     s = n // ndev
 
+    # pow2-bucketed segment-table size: a DATA-dependent num_keys in the
+    # cache key would mint a permanent compiled program per distinct key
+    # cardinality (code-review r4: unbounded growth in a long-lived
+    # service whose key count drifts); padding the dense table to the
+    # next power of two caps distinct programs at O(log max_keys), and
+    # the pad rows (no gid ever points at them) are sliced off below
+    padded_keys = 1 << max(0, int(num_keys) - 1).bit_length()
+
     def seg_psum(gids, *cols):
         outs = []
         for c in cols:
-            seg = jax.ops.segment_sum(c, gids, num_keys)
+            seg = jax.ops.segment_sum(c, gids, padded_keys)
             outs.append(lax.psum(seg, "data"))
         return tuple(outs)
 
@@ -430,17 +473,26 @@ def aggregate(
         in_specs = (P("data"),) + tuple(
             P("data", *([None] * (c.ndim - 1))) for c in main_cols
         )
-        sharded = jax.jit(
-            shard_map(
-                seg_psum,
-                mesh=mesh,
-                in_specs=in_specs,
-                out_specs=P(),
-                check_vma=False,
-            )
+        # cached like every other mesh program (round-3 verdict: this
+        # closure recompiled on every aggregate(mesh=...) call); the
+        # padded table size shapes the program, so it keys the entry
+        sharded = ex.cached(
+            f"shagg-sum-{_mesh_sig(mesh)}-{padded_keys}",
+            graph,
+            fetch_list,
+            feed_names,
+            lambda: jax.jit(
+                shard_map(
+                    seg_psum,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            ),
         )
         outs = sharded(gid[: s * ndev], *main_cols)
-        acc = [np.asarray(o) for o in outs]
+        acc = [np.asarray(o)[:num_keys] for o in outs]
     if tail_cols and tail_cols[0].shape[0] > 0:
         touts = [
             np.asarray(jax.ops.segment_sum(jnp.asarray(c), gid[s * ndev :], num_keys))
@@ -506,7 +558,7 @@ def _aggregate_mesh_general(
     # chunk feeds are (n, size, *cell) for every stage, so ONE shard_map
     # over the lead (chunk) axis serves both the chunk and combine stages
     sharded = ex.cached(
-        f"shagg-{ndev}",
+        f"shagg-{_mesh_sig(mesh)}",
         graph,
         fetch_list,
         feed_names,

@@ -11,6 +11,7 @@ B executions instead of B session setups.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -24,7 +25,7 @@ __all__ = ["Executor", "default_executor"]
 
 class Executor:
     def __init__(self):
-        self._cache: Dict[Tuple, Callable] = {}
+        self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
         self.compile_count = 0  # observability: distinct lowered callables
 
     def cached(
@@ -36,13 +37,23 @@ class Executor:
         make: Callable[[], Callable],
     ) -> Callable:
         """Generic compile cache: ``kind`` distinguishes execution styles of
-        the same graph (plain block call, vmapped per-row, scan fold, ...)."""
+        the same graph (plain block call, vmapped per-row, scan fold, ...).
+        LRU-bounded (`config.executor_cache_entries`) so a long-lived
+        process whose graphs drift does not accumulate compiled
+        executables without limit."""
         key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
         fn = self._cache.get(key)
         if fn is None:
             fn = make()
             self._cache[key] = fn
             self.compile_count += 1
+            from .. import config as _config
+
+            limit = max(1, int(_config.get().executor_cache_entries))
+            while len(self._cache) > limit:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
         return fn
 
     def callable_for(

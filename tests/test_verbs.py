@@ -432,6 +432,38 @@ class TestReviewRegressions:
         tfs.map_rows(g, df, fetch_names=fetches, executor=ex)
         assert ex.compile_count == n
 
+    def test_executor_cache_lru_bounded(self):
+        # code-review r4: the compile cache must not grow without bound
+        # in a long-lived process whose graphs drift. Hot entries
+        # survive eviction (LRU), cold ones are dropped and recompile.
+        from tensorframes_tpu import config as tfs_config
+
+        ex = tfs.Executor()
+        df = frame_of(x=np.arange(4.0))
+        x = tfs.block(df, "x")
+        graphs = [dsl.build((x + float(i)).named("z")) for i in range(5)]
+        with tfs_config.override(executor_cache_entries=3):
+            for g, fetches in graphs[:3]:
+                tfs.map_blocks(g, df, fetch_names=fetches, executor=ex)
+            assert len(ex._cache) == 3
+            # touch graph 0 so it is most-recent, then insert two more:
+            # graphs 1 and 2 evict, graph 0 survives
+            tfs.map_blocks(
+                graphs[0][0], df, fetch_names=graphs[0][1], executor=ex
+            )
+            for g, fetches in graphs[3:]:
+                tfs.map_blocks(g, df, fetch_names=fetches, executor=ex)
+            assert len(ex._cache) == 3
+            n = ex.compile_count
+            tfs.map_blocks(
+                graphs[0][0], df, fetch_names=graphs[0][1], executor=ex
+            )
+            assert ex.compile_count == n  # survived as most-recent
+            tfs.map_blocks(
+                graphs[1][0], df, fetch_names=graphs[1][1], executor=ex
+            )
+            assert ex.compile_count == n + 1  # evicted: recompiles
+
 
 class TestReduceBlocksStream:
     def test_streamed_chunks_match(self):

@@ -25,7 +25,7 @@ chose partition sizes), so this changes nothing semantically.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -55,12 +55,17 @@ def _base(name: str) -> str:
     return parse_edge(name)[0]
 
 
+@lru_cache(maxsize=64)
 def _mesh_sig(mesh: Mesh) -> str:
     """Cache-key signature of a mesh's concrete device identity. A
     cached shard_map program is bound to the devices it was traced
     over; two meshes with the same device COUNT but different devices
     (or a different topology) must never share an executor-cache entry,
-    or the reused program would run on the old mesh's chips."""
+    or the reused program would run on the old mesh's chips.
+
+    Memoized per Mesh (hashable in jax) — on a pod-scale mesh the
+    O(ndev) string build would otherwise run on every verb dispatch,
+    the same hot-path cost `Graph.fingerprint` memoizes away."""
     shape = "x".join(str(int(n)) for n in mesh.devices.shape)
     # device ids are unique only per backend: cpu:0 and tpu:0 are both
     # id 0, so the platform must disambiguate (virtual-CPU dry run
@@ -419,13 +424,13 @@ def aggregate(
     (`_aggregate_mesh_general`); anything else falls back to the host
     exact plan.
     """
-    ex = executor or default_executor()
     frame = grouped.frame
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
     if not _all_fetches_are_lead_sums(graph, fetch_list):
         return _aggregate_mesh_general(
             graph, grouped, mesh, feed_dict, fetch_list, executor
         )
+    ex = executor or default_executor()
     overrides = _api._ph_overrides(graph, frame, feed_dict, block_level=True)
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
     _api._validate_reduce_blocks(summary, fetch_list)

@@ -11,6 +11,7 @@ B executions instead of B session setups.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -26,6 +27,7 @@ __all__ = ["Executor", "default_executor"]
 class Executor:
     def __init__(self):
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self._lock = threading.Lock()
         self.compile_count = 0  # observability: distinct lowered callables
 
     def cached(
@@ -40,20 +42,31 @@ class Executor:
         the same graph (plain block call, vmapped per-row, scan fold, ...).
         LRU-bounded (`config.executor_cache_entries`) so a long-lived
         process whose graphs drift does not accumulate compiled
-        executables without limit."""
+        executables without limit. The bookkeeping is locked — the
+        default executor is shared across threads, and an unlocked
+        hit-path ``move_to_end`` can race a concurrent eviction into a
+        KeyError. ``make()`` itself runs OUTSIDE the lock (tracing can
+        be slow); a lost insert race reuses the winner's callable and
+        costs only a redundant trace."""
         key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = make()
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self._cache.move_to_end(key)
+                return fn
+        fn = make()
+        from .. import config as _config
+
+        limit = max(1, int(_config.get().executor_cache_entries))
+        with self._lock:
+            winner = self._cache.get(key)
+            if winner is not None:
+                self._cache.move_to_end(key)
+                return winner
             self._cache[key] = fn
             self.compile_count += 1
-            from .. import config as _config
-
-            limit = max(1, int(_config.get().executor_cache_entries))
             while len(self._cache) > limit:
                 self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(key)
         return fn
 
     def callable_for(
@@ -84,7 +97,8 @@ class Executor:
         return [np.asarray(o) for o in out]
 
     def clear(self) -> None:
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
 
 
 _default: Optional[Executor] = None

@@ -1,0 +1,230 @@
+"""Concurrency stress tests.
+
+The reference's DSL naming state was explicitly thread-UNSAFE — a
+mutable scope stack + name counters with a "will NOT work multithreaded"
+warning (`dsl/Paths.scala:10-12`), mitigated only by disabling sbt test
+parallelism (`project/Build.scala:21`). This build claims thread safety
+by construction (contextvars scope stack, per-build name counters, a
+GIL-atomic build memo, bounded prefetch queue with cancellation); these
+tests are the proof, and would have caught the reference's `Paths` bug
+class (cross-thread scope/counter bleed).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.api import _prefetch_iter
+
+N_THREADS = 4
+ITERS = 8
+
+
+def _run_threads(target, n=N_THREADS):
+    """Start n threads against a common barrier; re-raise the first
+    worker exception so failures are not silently swallowed."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrap(i):
+        try:
+            barrier.wait(timeout=30)
+            target(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced to pytest
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "worker thread hung"
+    if errors:
+        raise errors[0]
+
+
+class TestConcurrentVerbs:
+    def test_verbs_on_separate_frames(self):
+        """Each thread drives map_blocks + reduce_blocks on its own frame
+        through the SHARED default executor, interleaving compile-cache
+        hits/misses and device dispatch."""
+
+        def work(i):
+            base = float(i + 1)
+            df = tfs.TensorFrame.from_dict(
+                {"x": np.arange(100.0) * base}, num_blocks=4
+            )
+            x = tfs.block(df, "x")
+            z = (x + base).named("z")
+            for _ in range(ITERS):
+                out = tfs.map_blocks(z, df)
+                np.testing.assert_array_equal(
+                    out["z"].values, np.arange(100.0) * base + base
+                )
+                x_input = tfs.block(df, "x", tf_name="x_input")
+                s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+                total = tfs.reduce_blocks(s, df)
+                assert float(total) == np.arange(100.0).sum() * base
+
+        _run_threads(work)
+
+    def test_keyed_aggregate_concurrent(self):
+        def work(i):
+            card = i + 2
+            df = tfs.TensorFrame.from_dict(
+                {"k": np.arange(60) % card, "x": np.ones(60)}
+            )
+            x_input = tfs.block(df, "x", tf_name="x_input")
+            s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+            for _ in range(ITERS):
+                out = tfs.aggregate(s, tfs.group_by(df, "k"))
+                assert out["x"].values.sum() == 60.0
+                assert len(out["k"].values) == card
+
+        _run_threads(work)
+
+
+class TestConcurrentDslBuilding:
+    def test_scoped_names_do_not_bleed_across_threads(self):
+        """The reference's `Paths` failure mode: one shared scope stack
+        and one shared counter table. Here each thread opens its OWN
+        scope and builds anonymous nodes concurrently; every resulting
+        graph must contain exactly the thread's scope prefix and a
+        dense counter sequence — any cross-thread bleed produces a
+        foreign prefix or a hole in the numbering."""
+        results = {}
+
+        def work(i):
+            tag = f"t{i}"
+            for it in range(ITERS):
+                with dsl.scope(tag):
+                    a = dsl.constant(np.float32(1.0))
+                    b = dsl.constant(np.float32(2.0))
+                    c = a + b  # anonymous Add under the scope
+                    d = c * b  # anonymous Mul under the scope
+                g, fetches = dsl.build(d)
+                names = [n.name for n in g.nodes]
+                assert all(n.startswith(tag + "/") for n in names), names
+                foreign = [
+                    n
+                    for n in names
+                    if any(
+                        n.startswith(f"t{j}/") for j in range(N_THREADS) if j != i
+                    )
+                ]
+                assert not foreign, foreign
+            results[i] = True
+
+        _run_threads(work)
+        assert len(results) == N_THREADS
+
+    def test_nested_scopes_isolated_per_thread(self):
+        def work(i):
+            with dsl.scope(f"outer{i}"):
+                time.sleep(0.01 * (i % 3))  # stagger to force interleaving
+                with dsl.scope("inner"):
+                    x = dsl.constant(np.float32(i))
+                g, _ = dsl.build(dsl.identity(x).named("out"))
+            names = sorted(n.name for n in g.nodes)
+            assert names == [f"outer{i}/inner/Const", f"outer{i}/out"], names
+
+        _run_threads(work)
+
+
+class TestPrefetchCancellation:
+    def test_producer_stops_after_consumer_abandons(self):
+        produced = []
+
+        def src():
+            for i in range(100_000):
+                produced.append(i)
+                yield i
+
+        it = _prefetch_iter(src(), depth=1)
+        assert next(it) == 0
+        assert next(it) == 1
+        it.close()  # consumer walks away mid-stream
+        # the bounded queue + cancellation event must stop the producer
+        # promptly — poll until it quiesces instead of one fixed sleep
+        deadline = time.time() + 10
+        last = -1
+        while time.time() < deadline:
+            n = len(produced)
+            if n == last:
+                break
+            last = n
+            time.sleep(0.2)
+        else:
+            pytest.fail("producer never quiesced")
+        assert last < 1000, f"producer ran {last} items past abandonment"
+
+    def test_consumer_exception_propagates_and_cancels(self):
+        """reduce_blocks_stream: chunk 3 is malformed, so the device loop
+        raises mid-stream. The error must surface to the caller and the
+        producer must not keep synthesizing chunks behind the scenes."""
+        produced = []
+
+        def chunks():
+            for i in range(100_000):
+                produced.append(i)
+                if i == 2:
+                    # wrong column name: _match_columns raises downstream
+                    yield tfs.TensorFrame.from_dict({"wrong": np.ones(4)})
+                else:
+                    yield tfs.TensorFrame.from_dict({"x": np.ones(4)})
+
+        proto = tfs.TensorFrame.from_dict({"x": np.ones(4)})
+        x_input = tfs.block(proto, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        with pytest.raises(Exception):
+            tfs.reduce_blocks_stream(s, chunks())
+        deadline = time.time() + 10
+        last = -1
+        while time.time() < deadline:
+            n = len(produced)
+            if n == last:
+                break
+            last = n
+            time.sleep(0.2)
+        else:
+            pytest.fail("producer never quiesced")
+        assert last < 1000, f"producer ran {last} chunks past the failure"
+
+    def test_producer_error_reraised_in_consumer(self):
+        def src():
+            yield tfs.TensorFrame.from_dict({"x": np.ones(4)})
+            raise RuntimeError("synthetic ingest failure")
+
+        proto = tfs.TensorFrame.from_dict({"x": np.ones(4)})
+        x_input = tfs.block(proto, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        with pytest.raises(RuntimeError, match="synthetic ingest failure"):
+            tfs.reduce_blocks_stream(s, src())
+
+
+class TestExecutorCacheUnderContention:
+    def test_shared_executor_hammered(self):
+        """Many threads, few distinct graphs, tiny LRU bound: constant
+        eviction + concurrent insertion. Correctness must hold (worst
+        allowed outcome of a lost race is a redundant compile)."""
+        from tensorframes_tpu import config as tfs_config
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        x = tfs.block(df, "x")
+        graphs = [dsl.build((x + float(k)).named("z")) for k in range(6)]
+
+        def work(i):
+            for it in range(ITERS):
+                g, fetches = graphs[(i + it) % len(graphs)]
+                out = tfs.map_blocks(g, df, fetch_names=fetches)
+                k = float((i + it) % len(graphs))
+                np.testing.assert_array_equal(
+                    out["z"].values, np.arange(8.0) + k
+                )
+
+        with tfs_config.override(executor_cache_entries=3):
+            _run_threads(work)

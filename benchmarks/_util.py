@@ -29,3 +29,53 @@ def emit(metric: str, value: float, unit: str, baseline: Optional[float] = None)
     }
     print(json.dumps(line))
     return line
+
+
+def freeze_keras_inception_v3(input_hw: int):
+    """Build the PRODUCTION Inception-v3 architecture with Keras and
+    freeze it with TF2's `convert_variables_to_constants_v2` — the
+    modern form of the reference demo's freeze
+    (`read_image.py:111-124`). The ~2,200-node, ~96 MB graph is shaped
+    entirely by Keras, not by this repo. Weights are seeded-random: the
+    environment has zero egress and no cached pretrained checkpoints,
+    so `weights="imagenet"` cannot be satisfied — prediction agreement
+    vs a TF session is checked instead (`tests/test_foreign_graphdef.py`),
+    which is weight-independent evidence of correct ingestion/lowering.
+
+    Shared by the BASELINE-config-5 benchmark and the conformance test
+    so the graph measured is byte-identical to the graph validated.
+    Requires TensorFlow (an optional tool here, never a runtime dep);
+    raises ImportError where it is absent.
+
+    Returns (graph_bytes, input_node, output_node, tf_score_fn)."""
+    os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    import tensorflow as tf
+
+    tf.keras.utils.set_random_seed(7)
+    model = tf.keras.applications.InceptionV3(
+        weights=None, input_shape=(input_hw, input_hw, 3)
+    )
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2,
+    )
+
+    fn = tf.function(lambda x: model(x, training=False))
+    cf = fn.get_concrete_function(
+        tf.TensorSpec([None, input_hw, input_hw, 3], tf.float32)
+    )
+    frozen = convert_variables_to_constants_v2(cf)
+    gd = frozen.graph.as_graph_def()
+
+    def score(images):
+        out = frozen(tf.constant(images))
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out.numpy()
+
+    return (
+        gd.SerializeToString(),
+        frozen.inputs[0].name.split(":")[0],
+        frozen.outputs[0].name.split(":")[0],
+        score,
+    )

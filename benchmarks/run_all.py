@@ -23,6 +23,8 @@ SMOKE_SIZES = {
     "INCEPTION_IMAGES": "16",
     "INCEPTION_SIZE": "32",
     "INCEPTION_WIDTH": "8",
+    "INCEPTIONV3_IMAGES": "4",
+    "INCEPTIONV3_SIZE": "75",
     "RAGGED_ROWS": "20000",
     "RAGGED_LOOP_ROWS": "500",
     "OVERLAP_CHUNK_ROWS": "200000",
@@ -44,6 +46,7 @@ def main():
         "map_rows_mlp_bench",
         "aggregate_bench",
         "inception_bench",
+        "frozen_inception_v3_bench",
         "ragged_map_rows_bench",
         "stream_overlap_bench",
     ):

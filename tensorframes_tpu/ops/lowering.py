@@ -11,6 +11,9 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, List, Sequence, Tuple
 
+import jax
+import numpy as np
+
 from ..graph.ir import Graph, parse_edge
 from .registry import GraphLoweringError, LowerCtx, get_rule
 from . import standard  # noqa: F401  (populates the registry)
@@ -52,14 +55,53 @@ def build_callable(
                 "see ops.registry.registered_ops()"
             )
 
+    # Constant subgraphs (no placeholder ancestors) are evaluated ONCE
+    # here, at build time, and their host-numpy results baked into every
+    # call. Two reasons beyond avoiding redundant recompute across
+    # retraces/eval_shape probes: (a) shape arithmetic
+    # (Shape -> StridedSlice -> Pack feeding a Reshape, the Keras
+    # squeeze-excite pattern) must stay a compile-time fact — inside jit
+    # the first jnp op would mint a tracer and a downstream `ctx.static`
+    # would refuse a value that is in truth static; (b) XLA re-folds the
+    # constants anyway, so there is no loss. ensure_compile_time_eval
+    # guards the rare case of build_callable running under an outer
+    # trace (it is a no-op otherwise).
+    const_env: Dict[Tuple[str, int], Any] = {}
+    folded: set = set()
+    for node in order:
+        if node.op in ("Placeholder", "PlaceholderV2"):
+            continue
+        ins: List[Any] = []
+        ok = True
+        for edge in node.inputs:
+            dep, idx, ctrl = parse_edge(edge)
+            if ctrl:
+                continue
+            if (dep, idx) not in const_env:
+                ok = False
+                break
+            ins.append(const_env[(dep, idx)])
+        if not ok:
+            continue
+        with jax.ensure_compile_time_eval():
+            out = get_rule(node.op).fn(ctx, node, ins)
+        if isinstance(out, tuple):
+            for i, v in enumerate(out):
+                const_env[(node.name, i)] = np.asarray(v)
+        else:
+            const_env[(node.name, 0)] = np.asarray(out)
+        folded.add(node.name)
+
     def fn(*feed_arrays):
         if len(feed_arrays) != len(feed_pos):
             raise ValueError(
                 f"expected {len(feed_pos)} feeds {list(feed_names)}, "
                 f"got {len(feed_arrays)}"
             )
-        env: Dict[Tuple[str, int], Any] = {}
+        env: Dict[Tuple[str, int], Any] = dict(const_env)
         for node in order:
+            if node.name in folded:
+                continue
             if node.op in ("Placeholder", "PlaceholderV2"):
                 env[(node.name, 0)] = feed_arrays[feed_pos[node.name]]
                 continue
@@ -75,7 +117,26 @@ def build_callable(
                         "which was not produced"
                     )
                 ins.append(env[key])
-            out = get_rule(node.op).fn(ctx, node, ins)
+            rule_fn = get_rule(node.op).fn
+            if not any(isinstance(x, jax.core.Tracer) for x in ins):
+                # Concrete at TRACE time but not at build time: the
+                # Shape op returns a static numpy shape even for traced
+                # inputs, so Shape -> StridedSlice -> Pack chains (the
+                # Keras squeeze-excite reshape target) land here. They
+                # must evaluate concretely or the first jnp op would
+                # mint a tracer and a downstream `ctx.static` would
+                # refuse a value that is in truth static. These are
+                # per-specialization scalars — cheap — unlike the
+                # weight-constant chains folded once above.
+                with jax.ensure_compile_time_eval():
+                    out = rule_fn(ctx, node, ins)
+                out = (
+                    tuple(np.asarray(v) for v in out)
+                    if isinstance(out, tuple)
+                    else np.asarray(out)
+                )
+            else:
+                out = rule_fn(ctx, node, ins)
             if isinstance(out, tuple):
                 for i, v in enumerate(out):
                     env[(node.name, i)] = v

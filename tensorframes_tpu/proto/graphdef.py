@@ -156,6 +156,11 @@ class TensorProto:
             n = self.shape.num_elements
             if n is not None and arr.size == 1 and n > 1:
                 arr = np.repeat(arr, n)
+            if n is not None and arr.size == 0 and n > 0:
+                # proto3 elides default values for strings too: absent
+                # string_val means every element is "" (TF MakeNdarray
+                # pads with the empty string)
+                arr = np.array([""] * n, dtype=object)
             return arr.reshape(self.shape.assert_concrete())
         np_dt = self.dtype.np_dtype
         n = self.shape.num_elements
@@ -172,9 +177,16 @@ class TensorProto:
             arr = np.asarray(self.values, dtype=np_dt)
         if arr.size < n:
             if arr.size == 0:
-                raise ValueError("empty TensorProto for non-empty shape")
-            # TF fills by repeating the last value.
-            arr = np.concatenate([arr, np.full(n - arr.size, arr[-1], np_dt)])
+                # proto3 elides default values entirely: no content and
+                # no typed values means every element is zero (TF's
+                # MakeNdarray semantics — EfficientNet's frozen graphs
+                # carry e.g. a scalar 0.0 Cast operand this way)
+                arr = np.zeros(n, np_dt)
+            else:
+                # TF fills by repeating the last value.
+                arr = np.concatenate(
+                    [arr, np.full(n - arr.size, arr[-1], np_dt)]
+                )
         return arr[:n].reshape(self.shape.assert_concrete())
 
     @classmethod

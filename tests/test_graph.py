@@ -190,6 +190,47 @@ class TestLowering:
         with pytest.raises(GraphLoweringError, match="unsupported op"):
             build_callable(g, ["w"], [])
 
+    def test_shape_arithmetic_chain_constant_folds_under_jit(self):
+        # Keras squeeze-excite pattern: Reshape's target comes from
+        # Shape -> StridedSlice -> Pack. Under jit the first jnp op in
+        # that chain would mint a tracer, so the dispatch loop must
+        # evaluate all-concrete nodes at trace time
+        # (jax.ensure_compile_time_eval) for the Reshape to see a
+        # static shape.
+        from tensorframes_tpu.proto.graphdef import (
+            AttrValue,
+            TensorProto as TP,
+        )
+
+        def const(name, arr):
+            return GraphNode(
+                name, "Const", [],
+                {"value": AttrValue.of_tensor(TP.from_numpy(np.asarray(arr))),
+                 "dtype": AttrValue.of_type(ScalarType.int32)},
+            )
+
+        g = Graph([
+            GraphNode("x", "Placeholder", [], {
+                "dtype": AttrValue.of_type(ScalarType.float32)}),
+            GraphNode("shp", "Shape", ["x"]),
+            const("b0", np.array([0], np.int32)),
+            const("b1", np.array([1], np.int32)),
+            const("s1", np.array([1], np.int32)),
+            GraphNode("batch", "StridedSlice", ["shp", "b0", "b1", "s1"], {
+                "shrink_axis_mask": AttrValue.of_int(1)}),
+            const("one", np.int32(1)),
+            const("chan", np.int32(8)),
+            GraphNode("target", "Pack", ["batch", "one", "one", "chan"]),
+            GraphNode("out", "Reshape", ["x", "target"]),
+        ])
+        fn = jax.jit(build_callable(g, ["out"], ["x"]))
+        x = np.arange(2 * 8, dtype=np.float32).reshape(2, 8)
+        (out,) = fn(x)
+        assert out.shape == (2, 1, 1, 8)
+        np.testing.assert_array_equal(
+            np.asarray(out).reshape(2, 8), x
+        )
+
     def test_missing_feed(self):
         g, fetches = _simple_graph()
         with pytest.raises(GraphLoweringError, match="not fed"):

@@ -63,6 +63,19 @@ class TestTensorProto:
         tp = TensorProto(ScalarType.float32, Shape((2, 2)), values=[5.0])
         np.testing.assert_array_equal(tp.to_numpy(), np.full((2, 2), 5.0, np.float32))
 
+    def test_empty_proto_decodes_to_zeros(self):
+        # proto3 elides default values: no tensor_content AND no typed
+        # values means all-zero (TF MakeNdarray semantics). Keras
+        # EfficientNet frozen graphs carry e.g. a scalar 0.0 Cast
+        # operand exactly this way.
+        tp = TensorProto(ScalarType.float32, Shape(()))
+        assert float(tp.to_numpy()) == 0.0
+        tp2 = TensorProto(ScalarType.int32, Shape((2, 3)))
+        np.testing.assert_array_equal(tp2.to_numpy(), np.zeros((2, 3), np.int32))
+        # strings elide the same way: absent string_val means all ""
+        tp3 = TensorProto(ScalarType.string, Shape((2,)))
+        assert list(tp3.to_numpy()) == ["", ""]
+
     def test_string_tensor(self):
         arr = np.array(["ab", "c"], dtype=object)
         tp = TensorProto.from_numpy(arr)

@@ -67,10 +67,27 @@ def main():
     warm = make_chunk(0)
     tfs.reduce_blocks_stream(s, [warm, warm])
 
-    t0 = time.perf_counter()
-    for f in source():
-        pass
-    t_produce = time.perf_counter() - t0
+    def run_variant(throttle: float = 0.0, check: bool = False):
+        """(t_produce, t_stream) for one throttle setting — the ONE
+        measurement block all three variants share."""
+        t0 = time.perf_counter()
+        for f in source(throttle):
+            pass
+        tp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        total = tfs.reduce_blocks_stream(s, source(throttle))
+        ts = time.perf_counter() - t0
+        if check:
+            want = sum(
+                float(
+                    (np.arange(i, i + chunk_rows, dtype=np.float64) * 0.5)
+                    .astype(np.float32)
+                    .sum()
+                )
+                for i in range(n_chunks)
+            )
+            assert abs(float(total) - want) / max(abs(want), 1.0) < 1e-3
+        return tp, ts
 
     one = make_chunk(0)
     t0 = time.perf_counter()
@@ -78,14 +95,7 @@ def main():
         tfs.reduce_blocks(s, one)
     t_device = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    total = tfs.reduce_blocks_stream(s, source())
-    t_stream = time.perf_counter() - t0
-    want = sum(
-        float((np.arange(i, i + chunk_rows, dtype=np.float64) * 0.5).astype(np.float32).sum())
-        for i in range(n_chunks)
-    )
-    assert abs(float(total) - want) / max(abs(want), 1.0) < 1e-3
+    t_produce, t_stream = run_variant(check=True)
 
     def efficiency(tp, td, ts):
         denom = min(tp, td)
@@ -96,14 +106,21 @@ def main():
     overlap = efficiency(t_produce, t_device, t_stream)
 
     # throttled: ingest-bound regime — overlap must hide device work
-    t0 = time.perf_counter()
-    for f in source(throttle_s):
-        pass
-    t_produce_thr = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    tfs.reduce_blocks_stream(s, source(throttle_s))
-    t_stream_thr = time.perf_counter() - t0
+    t_produce_thr, t_stream_thr = run_variant(throttle_s)
     overlap_thr = efficiency(t_produce_thr, t_device, t_stream_thr)
+
+    # balanced: throttle tuned so producer cost ~ device cost — the
+    # regime where the efficiency denominator min(tp, td) is NOT noise
+    # (round-3 verdict weak #6: the natural configuration had the
+    # producer at 7% of wall, so the measured 0.50 said little; this is
+    # the rerun configuration, target >= 0.8). When the producer is
+    # ALREADY at or above device cost there is nothing to balance by
+    # sleeping — the variant degenerates to the natural regime and says
+    # so instead of reporting a noise-denominator number as balanced.
+    bal_throttle = max(0.0, (t_device - t_produce) / n_chunks)
+    balanced_degenerate = bal_throttle == 0.0
+    t_produce_bal, t_stream_bal = run_variant(bal_throttle)
+    overlap_bal = efficiency(t_produce_bal, t_device, t_stream_bal)
 
     import json
 
@@ -120,6 +137,11 @@ def main():
                 "t_stream_s": round(t_stream, 3),
                 "overlap_throttled": round(overlap_thr, 4),
                 "t_stream_throttled_s": round(t_stream_thr, 3),
+                "overlap_balanced": round(overlap_bal, 4),
+                "balanced_degenerate": balanced_degenerate,
+                "balanced_throttle_s": round(bal_throttle, 4),
+                "t_produce_balanced_s": round(t_produce_bal, 3),
+                "t_stream_balanced_s": round(t_stream_bal, 3),
             }
         )
     )

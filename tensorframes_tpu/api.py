@@ -1280,8 +1280,10 @@ class GroupedFrame:
             info = frame.info[k]
             if not info.cell_shape.is_scalar:
                 raise ValueError(f"group key {k!r} must be a scalar column")
-            if not frame.column(k).is_dense:
-                raise ValueError(f"group key {k!r} must be dense")
+            # scalar columns are always groupable: dense ones directly,
+            # string/object ones via Column.host_values() — the
+            # reference grouped by ANY Catalyst column type, so string
+            # keys (the common case from Arrow/Spark ingest) must work
 
 
 def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
@@ -1298,7 +1300,7 @@ def _group_plan(
     ``(key_out, num_groups, counts, starts, col_data)`` — the one copy of
     the Catalyst-shuffle analogue both the host and mesh paths use."""
     frame = grouped.frame
-    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
     key_out, inverse = factorize_keys(grouped.keys, key_arrays)
     num_groups = len(next(iter(key_out.values())))
     order = np.argsort(inverse, kind="stable")
@@ -1314,7 +1316,18 @@ def _keyed_output(
     bases: List[str],
 ) -> TensorFrame:
     """Key columns + sorted output columns (`DebugRowOps.scala:583-598`)."""
-    cols = [Column(k, v) for k, v in key_out.items()]
+    from .schema import ScalarType
+
+    cols = []
+    for k, v in key_out.items():
+        v = np.asarray(v)
+        if v.size == 0 and v.dtype == object:
+            # a 0-row string-keyed aggregate (empty Spark/Arrow
+            # partition) must return an empty frame like the numeric
+            # case, not fail Column's empty-ragged dtype check
+            cols.append(Column(k, v, ScalarType.string))
+        else:
+            cols.append(Column(k, v))
     cols += [Column(b, results[b]) for b in sorted(bases)]
     return TensorFrame(cols)
 
@@ -1477,7 +1490,7 @@ def _aggregate_segment(
     own driver-side pairwise combine reassociated too,
     `DebugRowOps.scala:748-757`)."""
     frame = grouped.frame
-    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
     key_out, inverse = factorize_keys(grouped.keys, key_arrays)
     num_groups = len(next(iter(key_out.values())))
     bases = [_base(f) for f in fetch_list]

@@ -149,6 +149,23 @@ class Column:
     def rows(self) -> Iterable[np.ndarray]:
         return iter(self.values) if self.is_dense else iter(self.ragged)  # type: ignore[arg-type]
 
+    def host_values(self) -> np.ndarray:
+        """One host array of all cells, for host-side consumers (group
+        keys, pandas export). Dense columns return their array; scalar
+        string/object columns — which never densify because they cannot
+        go to device — assemble an object vector (the reference grouped
+        by ANY Catalyst column type, so string group keys must work)."""
+        if self.is_dense:
+            return self.values
+        if not self.cell_shape.is_scalar:
+            raise ValueError(
+                f"column {self.name!r} is ragged; no single host array"
+            )
+        out = np.empty(len(self.ragged), dtype=object)  # type: ignore[arg-type]
+        for i, c in enumerate(self.ragged):  # type: ignore[union-attr]
+            out[i] = np.asarray(c)[()]  # ragged cells are 0-d ndarrays here
+        return out
+
     def analyzed_cell_shape(self) -> Shape:
         """Scan all cells and merge shapes with unknown-widening
         (`ExperimentalOperations.scala:140-178`)."""

@@ -227,7 +227,7 @@ def aggregate_global(
     key_cols = list(grouped.keys)
     counts = np.bincount(
         _api.factorize_keys(
-            key_cols, [frame.column(k).values for k in key_cols]
+            key_cols, [frame.column(k).host_values() for k in key_cols]
         )[1]
     )
 
@@ -248,12 +248,44 @@ def aggregate_global(
         pad_shape = (nmax - arr.shape[0],) + arr.shape[1:]
         padded = np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
         return np.asarray(multihost_utils.process_allgather(padded))
+
+    def _gather_ragged(arr: np.ndarray) -> np.ndarray:
+        """Gather + unpad one column across processes. String/object key
+        columns (allgather moves numbers, not objects) ride as
+        fixed-width UCS4 code matrices: pad every process's strings to
+        the GLOBAL max character width, view as uint32, gather, decode.
+        Pad rows decode to "" but are sliced off by the true lengths."""
+        arr = np.asarray(arr)
+        if arr.dtype == object or arr.dtype.kind in ("U", "S"):
+            sarr = np.array([str(x) for x in arr], dtype="<U1") if arr.size == 0 \
+                else np.array([str(x) for x in arr])
+            w = max(1, sarr.dtype.itemsize // 4)
+            wmax = int(
+                np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([w], dtype=np.int64)
+                    )
+                ).max()
+            )
+            sarr = sarr.astype(f"<U{wmax}")
+            codes = (
+                sarr.view(np.uint32).reshape(len(sarr), wmax)
+                if len(sarr)
+                else np.zeros((0, wmax), np.uint32)
+            )
+            g = _gather(codes)
+            flat = np.concatenate(
+                [g[p, : lens[p]] for p in range(g.shape[0])]
+            )
+            return np.ascontiguousarray(flat).view(f"<U{wmax}").ravel()
+        g = _gather(arr)
+        return np.concatenate([g[p, : lens[p]] for p in range(g.shape[0])])
+
     gathered = {}
-    for name in key_cols + bases:
-        g = _gather(np.asarray(local.column(name).values))
-        gathered[name] = np.concatenate(
-            [g[p, : lens[p]] for p in range(g.shape[0])]
-        )
+    for name in key_cols:
+        gathered[name] = _gather_ragged(local.column(name).host_values())
+    for name in bases:
+        gathered[name] = _gather_ragged(np.asarray(local.column(name).values))
     gcounts = _gather(counts.astype(np.int64))
     weights = np.concatenate(
         [gcounts[p, : lens[p]] for p in range(gcounts.shape[0])]

@@ -440,7 +440,7 @@ def aggregate(
     # host: factorize keys once (global key table)
     from ..frame import factorize_keys
 
-    key_arrays = [frame.column(k).values for k in grouped.keys]
+    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
     key_out, inverse = factorize_keys(grouped.keys, key_arrays)
     num_keys = len(next(iter(key_out.values())))
     gid = inverse.astype(_gid_dtype(num_keys))

@@ -154,8 +154,11 @@ def _collected_frame(paths: Sequence[str]) -> TensorFrame:
         return frames[0]
     cols = {}
     for name in frames[0].columns:
+        # host_values covers string/object columns too (dense columns
+        # return their array unchanged) — group keys from Spark arrive
+        # as Arrow strings
         cols[name] = np.concatenate(
-            [np.asarray(f.column(name).values) for f in frames]
+            [np.asarray(f.column(name).host_values()) for f in frames]
         )
     out = TensorFrame.from_dict(cols)
     # one block per ingested chunk — the Spark partition boundaries

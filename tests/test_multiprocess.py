@@ -94,6 +94,33 @@ WORKER = textwrap.dedent(
             assert abs(got[int(k)] - all_x[all_k == k].sum()) < 1e-9
         print(f"proc {pid} OK agg", flush=True)
 
+    elif scenario == "aggregate-strings":
+        # string keys across processes: the partial tables' key columns
+        # ride DCN as fixed-width UCS4 code matrices (allgather moves
+        # numbers, not objects) with uneven per-process group counts
+        names = np.array(["alpha", "b", "gamma"], dtype=object)
+        keys = names[(np.arange(4) + pid) % 3]
+        local_kv = tfs.TensorFrame.from_dict(
+            {"k": keys, "x": np.arange(4.0) + 4 * pid}
+        )
+        x_input = tfs.block(local_kv, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = mh.aggregate_global(s, tfs.group_by(local_kv, "k"))
+        got = dict(
+            zip(
+                [str(v) for v in out["k"].host_values()],
+                out["x"].values.tolist(),
+            )
+        )
+        all_k = np.concatenate(
+            [names[(np.arange(4) + p) % 3] for p in range(nprocs)]
+        )
+        all_x = np.arange(4.0 * nprocs)
+        for k in np.unique([str(v) for v in all_k]):
+            want = all_x[[str(v) == k for v in all_k]].sum()
+            assert abs(got[k] - want) < 1e-9, (k, got, want)
+        print(f"proc {pid} OK agg-strings", flush=True)
+
     elif scenario == "analyze":
         # ragged vectors whose lengths agree within a host but differ
         # across hosts -> merged cell shape must widen to unknown
@@ -181,6 +208,11 @@ def test_global_map_blocks(tmp_path, nprocs):
 @pytest.mark.parametrize("nprocs", [2, 4])
 def test_global_aggregate(tmp_path, nprocs):
     _run_workers(tmp_path, nprocs, "aggregate")
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_global_aggregate_string_keys(tmp_path, nprocs):
+    _run_workers(tmp_path, nprocs, "aggregate-strings")
 
 
 def test_distributed_analyze(tmp_path):

@@ -417,6 +417,29 @@ class TestMeshCompileCaching:
 
 
 class TestMultiKeyAggregateMesh:
+    def test_string_keys_over_mesh(self, mesh):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "k": np.array(list("abca") * 4, dtype=object),
+                "x": np.arange(16.0),
+            }
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "k"), mesh=mesh)
+        got = dict(
+            zip(
+                [str(v) for v in out["k"].host_values()],
+                out["x"].values.tolist(),
+            )
+        )
+        data = np.arange(16.0)
+        keys = np.array(list("abca") * 4)
+        assert got == {
+            c: float(data[keys == c].sum()) for c in ("a", "b", "c")
+        }
+
     def test_two_keys_over_mesh(self, mesh):
         import tensorframes_tpu as tfs
         from tensorframes_tpu import dsl

@@ -317,6 +317,31 @@ class TestSparkAdapterPyarrowOnly:
         )
         assert float(total) == np.arange(9.0).sum()
 
+    def test_string_keyed_aggregate(self, tmp_path):
+        # Spark group keys arrive as Arrow strings (object dtype on the
+        # numpy side); the adapter must carry them through collection
+        # and keyed aggregation.
+        import tensorframes_tpu.spark as tfspark
+
+        fake = self._fake_df(
+            [
+                {"k": ["a", "b", "a"], "x": np.array([1.0, 2.0, 3.0])},
+                {"k": ["b", "c"], "x": np.array([4.0, 5.0])},
+            ]
+        )
+        probe = tfs.TensorFrame.from_dict({"x": np.zeros(4)})
+        s = _sum_graph(probe)
+        out = tfspark.aggregate(
+            s, fake, keys=["k"], ingest_dir=str(tmp_path / "sk")
+        )
+        got = sorted(
+            zip(
+                [str(v) for v in out["k"].host_values()],
+                out["x"].values.tolist(),
+            )
+        )
+        assert got == [("a", 4.0), ("b", 6.0), ("c", 5.0)]
+
     def test_empty_ingest_raises(self, tmp_path):
         import tensorframes_tpu.spark as tfspark
 

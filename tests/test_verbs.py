@@ -302,6 +302,63 @@ class TestAggregate:
         got = dict(zip(out["key"].values.tolist(), out["x"].values.tolist()))
         assert got == {1: 3.0, 2: 60.0}
 
+    def test_string_group_keys(self):
+        # The reference grouped by ANY Catalyst column type; string keys
+        # are the common case from Arrow/Spark ingest (pyarrow string
+        # columns arrive as object dtype, which never densifies).
+        df = tfs.TensorFrame.from_dict(
+            {
+                "k": np.array(["a", "b", "a", "c"], dtype=object),
+                "x": np.arange(4.0),
+            }
+        )
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "k"))
+        got = dict(
+            zip(
+                [str(v) for v in out["k"].host_values()],
+                out["x"].values.tolist(),
+            )
+        )
+        assert got == {"a": 2.0, "b": 1.0, "c": 3.0}
+        pdf = out.to_pandas().sort_values("k")
+        assert pdf["x"].tolist() == [2.0, 1.0, 3.0]
+
+    def test_empty_string_keyed_aggregate(self):
+        # code-review r4: a 0-row string-keyed aggregate (empty
+        # Spark/Arrow partition) must return an empty frame like the
+        # numeric case — in aggregate_global a crash here would kill
+        # one process before its collectives and hang the others.
+        from tensorframes_tpu.schema import ScalarType
+
+        df0 = tfs.TensorFrame.from_dict(
+            {"k": np.array([], dtype=object), "x": np.zeros(0)},
+            dtypes={"k": ScalarType.string},
+        )
+        probe = tfs.TensorFrame.from_dict({"x": np.zeros(4)})
+        x_input = tfs.block(probe, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df0, "k"))
+        assert out.nrows == 0
+        assert set(out.columns) == {"k", "x"}
+
+    def test_mixed_dtype_multi_key(self):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "a": np.array(["p", "q", "p"], dtype=object),
+                "b": np.array([1, 1, 2], dtype=np.int64),
+                "x": np.arange(3.0),
+            }
+        )
+        x_input = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = tfs.aggregate(s, tfs.group_by(df, "a", "b"))
+        pdf = out.to_pandas().sort_values(["a", "b"]).reset_index(drop=True)
+        assert [tuple(r) for r in pdf.to_numpy()] == [
+            ("p", 1, 0.0), ("p", 2, 2.0), ("q", 1, 1.0),
+        ]
+
     def test_grouped_vector_mean_two_outputs(self):
         df = tfs.TensorFrame.from_dict(
             {

@@ -1500,14 +1500,47 @@ def _aggregate_segment(
 
     needs_counts = "mean" in combiners.values()
 
+    # TPU-first sum lowering: XLA turns segment_sum into scatter-add,
+    # which serializes on the TPU; for modest key counts a one-hot
+    # matmul computes the same dense table on the MXU
+    # (out[k] = sum_n onehot[n,k] * data[n] — one big matmul). Keys the
+    # cache entry because it changes the compiled program.
+    from . import config as _config
+
+    onehot_keys = _config.get().aggregate_onehot_keys
+    if onehot_keys is None:  # auto: only where scatter-add is the slow path
+        onehot_keys = 256 if jax.default_backend() == "tpu" else 0
+    # the one-hot operand is a dense (rows x keys) matrix XLA must
+    # materialize — bound the PRODUCT too, or a row count the scatter
+    # plan handled fine would OOM HBM (256M f32 elements = 1 GB). The
+    # decision is per CALL (row count varies across calls of one graph)
+    # and is part of the cache kind below, so plans never alias.
+    use_onehot = (
+        0 < num_groups <= int(onehot_keys)
+        and grouped.frame.nrows * num_groups <= 268_435_456
+    )
+
     def make():
+        import jax.numpy as jnp
+
         raw = build_callable(graph, roots, feed_names)
+        # sum/mean route through seg_sum above this table
         segment_of = {
-            "sum": jax.ops.segment_sum,
             "min": jax.ops.segment_min,
             "max": jax.ops.segment_max,
             "prod": jax.ops.segment_prod,
         }
+
+        def seg_sum(o, gid):
+            if not (use_onehot and jnp.issubdtype(o.dtype, jnp.floating)):
+                return jax.ops.segment_sum(o, gid, num_groups)
+            onehot = jax.nn.one_hot(gid, num_groups, dtype=o.dtype)
+            flat = o.reshape(o.shape[0], -1)
+            out = jax.lax.dot_general(
+                onehot, flat, (((0,), (0,)), ((), ())),
+                precision=_config.get().lax_precision(),
+            )
+            return out.reshape((num_groups,) + o.shape[1:])
 
         def fn(gid, counts, *feeds):
             outs = raw(*feeds)
@@ -1515,11 +1548,13 @@ def _aggregate_segment(
             for b, o in zip(bases, outs):
                 comb = combiners[b]
                 if comb == "mean":
-                    s = jax.ops.segment_sum(o, gid, num_groups)
+                    s = seg_sum(o, gid)
                     c = counts.astype(o.dtype).reshape(
                         (-1,) + (1,) * (s.ndim - 1)
                     )
                     res.append(s / c)
+                elif comb == "sum":
+                    res.append(seg_sum(o, gid))
                 else:
                     res.append(segment_of[comb](o, gid, num_groups))
             return tuple(res)
@@ -1527,7 +1562,8 @@ def _aggregate_segment(
         return jax.jit(fn)
 
     sfn = ex.cached(
-        f"segagg-{num_groups}-{comb_sig}", graph, fetch_list, feed_names, make
+        f"segagg-{num_groups}-{comb_sig}-{int(use_onehot)}",
+        graph, fetch_list, feed_names, make,
     )
     gid = inverse.astype(_gid_dtype(num_groups))
     # counts ride as exact int32 and convert to the fetch dtype in-graph;

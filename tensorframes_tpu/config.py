@@ -55,6 +55,14 @@ class Config:
     # 10M-row TPU benchmark scale). Accumulation order differs from the
     # exact whole-group plan (FP reassociation). Off = exact/chunk plans.
     aggregate_segment_fast: bool = True
+    # aggregate: float Sum/Mean segment tables with at most this many
+    # DISTINCT KEYS compute as a one-hot matmul on the MXU instead of
+    # XLA's scatter-add lowering of segment_sum (scatter serializes on
+    # TPU; a (rows x keys) @ (rows x cell) matmul does not). None =
+    # auto: 256 on TPU, 0 elsewhere — on CPU/GPU scatter-add is fast
+    # and the matmul's extra FLOPs only cost (measured ~28x slower on
+    # CPU). Set an int to force either way.
+    aggregate_onehot_keys: Optional[int] = None
     # Executor compile-cache bound (LRU): long-lived services whose
     # graphs / shapes drift would otherwise accumulate compiled
     # executables forever (the cache is never cleared implicitly).

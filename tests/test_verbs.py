@@ -325,6 +325,32 @@ class TestAggregate:
         pdf = out.to_pandas().sort_values("k")
         assert pdf["x"].tolist() == [2.0, 1.0, 3.0]
 
+    def test_onehot_and_scatter_segment_plans_agree(self):
+        # The MXU one-hot matmul lowering (num_keys <=
+        # config.aggregate_onehot_keys) must agree with the scatter-add
+        # segment_sum lowering up to FP reassociation, for Sum and Mean.
+        from tensorframes_tpu import config as tfs_config
+
+        rng = np.random.RandomState(3)
+        keys = rng.randint(0, 37, 5000).astype(np.int64)
+        vals = rng.rand(5000, 3)
+        df = tfs.TensorFrame.from_dict({"k": keys, "v": vals})
+        vi = tfs.block(df, "v", tf_name="v_input")
+        for make_s in (
+            lambda: dsl.reduce_sum(vi, axes=[0]).named("v"),
+            lambda: dsl.reduce_mean(vi, axes=[0]).named("v"),
+        ):
+            # forced on (the auto default only engages on TPU backends)
+            with tfs_config.override(aggregate_onehot_keys=256):
+                out_oh = tfs.aggregate(make_s(), tfs.group_by(df, "k"))
+            with tfs_config.override(aggregate_onehot_keys=0):
+                out_sc = tfs.aggregate(make_s(), tfs.group_by(df, "k"))
+            np.testing.assert_allclose(
+                np.asarray(out_oh["v"].values),
+                np.asarray(out_sc["v"].values),
+                rtol=1e-10,
+            )
+
     def test_empty_string_keyed_aggregate(self):
         # code-review r4: a 0-row string-keyed aggregate (empty
         # Spark/Arrow partition) must return an empty frame like the

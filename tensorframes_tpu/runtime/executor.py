@@ -21,7 +21,29 @@ import numpy as np
 from ..graph.ir import Graph
 from ..ops.lowering import build_callable
 
-__all__ = ["Executor", "default_executor"]
+__all__ = ["Executor", "default_executor", "lru_get_or_insert"]
+
+
+def lru_get_or_insert(cache, lock, key, make, limit):
+    """The ONE locked-LRU discipline both executors use: hit moves to
+    the tail; a miss builds OUTSIDE the lock (tracing/compiling can be
+    slow) and a lost insert race reuses the winner's value, costing only
+    the redundant build. Returns (value, inserted)."""
+    with lock:
+        fn = cache.get(key)
+        if fn is not None:
+            cache.move_to_end(key)
+            return fn, False
+    fn = make()
+    with lock:
+        winner = cache.get(key)
+        if winner is not None:
+            cache.move_to_end(key)
+            return winner, False
+        cache[key] = fn
+        while len(cache) > max(1, int(limit)):
+            cache.popitem(last=False)
+    return fn, True
 
 
 class Executor:
@@ -42,31 +64,18 @@ class Executor:
         the same graph (plain block call, vmapped per-row, scan fold, ...).
         LRU-bounded (`config.executor_cache_entries`) so a long-lived
         process whose graphs drift does not accumulate compiled
-        executables without limit. The bookkeeping is locked — the
-        default executor is shared across threads, and an unlocked
-        hit-path ``move_to_end`` can race a concurrent eviction into a
-        KeyError. ``make()`` itself runs OUTSIDE the lock (tracing can
-        be slow); a lost insert race reuses the winner's callable and
-        costs only a redundant trace."""
+        executables without limit; see `lru_get_or_insert` for the
+        locking discipline (the default executor is shared across
+        threads)."""
         key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
-        with self._lock:
-            fn = self._cache.get(key)
-            if fn is not None:
-                self._cache.move_to_end(key)
-                return fn
-        fn = make()
         from .. import config as _config
 
-        limit = max(1, int(_config.get().executor_cache_entries))
-        with self._lock:
-            winner = self._cache.get(key)
-            if winner is not None:
-                self._cache.move_to_end(key)
-                return winner
-            self._cache[key] = fn
+        fn, inserted = lru_get_or_insert(
+            self._cache, self._lock, key, make,
+            _config.get().executor_cache_entries,
+        )
+        if inserted:
             self.compile_count += 1
-            while len(self._cache) > limit:
-                self._cache.popitem(last=False)
         return fn
 
     def callable_for(

@@ -26,6 +26,8 @@ host that owns the TPU client.
 from __future__ import annotations
 
 import re
+import threading
+from collections import OrderedDict
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -40,6 +42,12 @@ __all__ = ["NativeExecutor"]
 # when the host has enough devices, otherwise they need the in-process
 # JAX executor (see `cached`).
 _MESH_KIND_PREFIXES = ("shmap-", "shred-", "shfold-", "shagg-")
+
+# Lowering flips the PROCESS-GLOBAL jax_use_shardy_partitioner flag
+# (restored in a finally); concurrent first-call compiles from two
+# threads would race the flip/restore and could leave the flag off for
+# unrelated JAX code. One lock serializes all native lowerings.
+_LOWER_LOCK = threading.Lock()
 
 
 class NativeExecutor:
@@ -60,11 +68,28 @@ class NativeExecutor:
         create_options = (
             {"cpu_device_count": int(devices)} if devices else None
         )
-        self.host = PjrtHost(plugin_path, create_options=create_options)
-        self._cache: Dict[Tuple, Callable] = {}
+        self._bind_host(
+            PjrtHost(plugin_path, create_options=create_options),
+            jax_fallback,
+        )
+
+    def _bind_host(self, host, jax_fallback: bool = False) -> None:
+        """All non-host state in one place (also the seam tests use to
+        wrap an existing host without claiming the plugin twice)."""
+        self.host = host
+        self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
+        self._lock = threading.Lock()
         self.compile_count = 0
         self._allow_jax_fallback = jax_fallback
         self._jax_fallback = None
+
+    @classmethod
+    def for_host(cls, host, jax_fallback: bool = False) -> "NativeExecutor":
+        """Executor over an ALREADY-CREATED host (one host per process
+        per plugin; creating a second claims the device again)."""
+        ex = cls.__new__(cls)
+        ex._bind_host(host, jax_fallback)
+        return ex
 
     def _native_run(self, traceable: Callable) -> Callable:
         """Wrap a jittable function (possibly taking/returning pytrees)
@@ -97,17 +122,18 @@ class NativeExecutor:
                 # Shardy is disabled for the lowering: the host's plugins
                 # consume classic GSPMD StableHLO (custom_call @Sharding /
                 # SPMDFullToShardShape), not the sdy dialect.
-                prev_sdy = jax.config.jax_use_shardy_partitioner
-                jax.config.update("jax_use_shardy_partitioner", False)
-                try:
-                    lowered = jax.jit(traceable, keep_unused=True).lower(
-                        *structs
-                    )
-                    mlir = str(lowered.compiler_ir(dialect="stablehlo"))
-                finally:
-                    jax.config.update(
-                        "jax_use_shardy_partitioner", prev_sdy
-                    )
+                with _LOWER_LOCK:
+                    prev_sdy = jax.config.jax_use_shardy_partitioner
+                    jax.config.update("jax_use_shardy_partitioner", False)
+                    try:
+                        lowered = jax.jit(traceable, keep_unused=True).lower(
+                            *structs
+                        )
+                        mlir = str(lowered.compiler_ir(dialect="stablehlo"))
+                    finally:
+                        jax.config.update(
+                            "jax_use_shardy_partitioner", prev_sdy
+                        )
                 out_flat, out_tree = jax.tree_util.tree_flatten(
                     lowered.out_info
                 )
@@ -173,13 +199,19 @@ class NativeExecutor:
                 kind, graph, fetches, feed_names, make
             )
         key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
-        fn = self._cache.get(key)
-        if fn is None:
-            # `make()` hands back a jax.jit-wrapped program; it is used
-            # here purely as a lowering recipe — execution never touches
-            # the in-process JAX backend.
-            fn = self._native_run(make())
-            self._cache[key] = fn
+        from .. import config as _config
+        from .executor import lru_get_or_insert
+
+        # the shared locked-LRU discipline (evicted wrappers free their
+        # PJRT executables via NativeExecutable.__del__ once no call
+        # holds them). `make()` hands back a jax.jit-wrapped program —
+        # used purely as a lowering recipe; execution never touches the
+        # in-process JAX backend.
+        fn, _ = lru_get_or_insert(
+            self._cache, self._lock, key,
+            lambda: self._native_run(make()),
+            _config.get().executor_cache_entries,
+        )
         return fn
 
     def callable_for(

@@ -196,11 +196,19 @@ class NativeExecutable:
         return self._host._execute(self._handle, list(inputs), list(out_specs))
 
     def close(self):
-        if self._handle:
+        # a closed host already destroyed the client (and with it every
+        # executable) — freeing against a NULL ctx would segfault
+        if self._handle and getattr(self._host, "_ctx", None):
             self._host._lib.tfs_pjrt_executable_free(
                 self._host._ctx, self._handle
             )
-            self._handle = None
+        self._handle = None
+
+    def __del__(self):  # executor-cache eviction must free the handle
+        try:
+            self.close()
+        except Exception:
+            pass  # interpreter teardown: host/lib may already be gone
 
 
 def _axon_default_options() -> dict:

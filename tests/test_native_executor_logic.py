@@ -60,13 +60,7 @@ class InProcessCpuHost:
 
 @pytest.fixture()
 def ex():
-    e = NativeExecutor.__new__(NativeExecutor)
-    e.host = InProcessCpuHost()
-    e._cache = {}
-    e.compile_count = 0
-    e._allow_jax_fallback = False
-    e._jax_fallback = None
-    return e
+    return NativeExecutor.for_host(InProcessCpuHost())
 
 
 class TestNativeExecutorKinds:

@@ -168,16 +168,11 @@ class TestPjrtHost:
 
 
 def _executor_on(host):
-    """A NativeExecutor bound to the module-scoped host (bypasses
-    __init__ so only ONE host claims the plugin per test session)."""
+    """A NativeExecutor bound to the module-scoped host (so only ONE
+    host claims the plugin per test session)."""
     from tensorframes_tpu.runtime.native_executor import NativeExecutor
 
-    ex = NativeExecutor.__new__(NativeExecutor)
-    ex.host = host
-    ex._cache = {}
-    ex.compile_count = 0
-    ex._allow_jax_fallback = False
-    ex._jax_fallback = None
+    ex = NativeExecutor.for_host(host)
     ex._jax_fallback_unused = lambda: ex._jax_fallback is None
     return ex
 

@@ -235,13 +235,22 @@ def reduce_blocks(
     ndev = mesh.devices.size
     main, tail, s = _split(frame, cols_used, ndev)
     fn = build_callable(graph, fetch_list, feed_names)
+    # Combining partials re-feeds fn: outputs arrive in FETCH order but
+    # fn's positional args are the SORTED feed names, and with several
+    # fetches those orders differ (x/n fetches sort as n_input, x_input)
+    # — feeding positionally would silently swap results between
+    # fetches. feed_src[j] = index of the fetch whose partial feeds
+    # feed_names[j] (the host path re-keys by name the same way).
+    fetch_of_feed = {_base(f) + "_input": i for i, f in enumerate(fetch_list)}
+    feed_src = [fetch_of_feed[n] for n in feed_names]
 
     partials: List[Tuple[np.ndarray, ...]] = []
     if s > 0:
         def local_then_gather(*cols):
             part = fn(*cols)
             gathered = [
-                lax.all_gather(p, "data", axis=0, tiled=False) for p in part
+                lax.all_gather(part[i], "data", axis=0, tiled=False)
+                for i in feed_src
             ]
             final = fn(*gathered)
             return tuple(final)
@@ -277,7 +286,7 @@ def reduce_blocks(
     else:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
         stacked = [
-            np.stack([p[i] for p in partials]) for i in range(len(fetch_list))
+            np.stack([p[i] for p in partials]) for i in feed_src
         ]
         final = tuple(np.asarray(o) for o in tfn(*stacked))
     if len(fetch_list) == 1:

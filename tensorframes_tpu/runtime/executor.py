@@ -75,7 +75,8 @@ class Executor:
             _config.get().executor_cache_entries,
         )
         if inserted:
-            self.compile_count += 1
+            with self._lock:  # += is not atomic; keep the count exact
+                self.compile_count += 1
         return fn
 
     def callable_for(

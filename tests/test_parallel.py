@@ -78,6 +78,36 @@ class TestDistributedReduceBlocks:
         res = tfs.reduce_blocks(v, df, mesh=mesh)
         np.testing.assert_allclose(res, df["v"].values.sum(0))
 
+    def test_multi_fetch_results_not_swapped(self, mesh):
+        # Regression: with several fetches, outputs arrive in fetch
+        # order but the combine re-feeds fn in SORTED feed-name order —
+        # x/n sort differently, and the mesh path once fed partials
+        # positionally, silently swapping results between fetches.
+        df = tfs.TensorFrame.from_dict(
+            {
+                "x": np.arange(16.0, dtype=np.float32),
+                "n": np.ones(16, np.int32),
+            }
+        )
+        xi = tfs.block(df, "x", tf_name="x_input")
+        ni = tfs.block(df, "n", tf_name="n_input")
+        s1 = dsl.reduce_sum(xi, axes=[0]).named("x")
+        s2 = dsl.reduce_sum(ni, axes=[0]).named("n")
+        out = tfs.reduce_blocks([s1, s2], df, mesh=mesh)
+        assert float(out["x"]) == 120.0
+        assert int(out["n"]) == 16
+        # 19 rows: main shards + tail partial exercise the host-side
+        # partial combine ordering too
+        df2 = tfs.TensorFrame.from_dict(
+            {
+                "x": np.arange(19.0, dtype=np.float32),
+                "n": np.ones(19, np.int32),
+            }
+        )
+        out2 = tfs.reduce_blocks([s1, s2], df2, mesh=mesh)
+        assert float(out2["x"]) == float(np.arange(19.0).sum())
+        assert int(out2["n"]) == 19
+
     def test_small_frame_fewer_rows_than_devices(self, mesh):
         df = tfs.TensorFrame.from_dict({"x": np.array([1.0, 2.0, 3.0])})
         x_input = tfs.block(df, "x", tf_name="x_input")

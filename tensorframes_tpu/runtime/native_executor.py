@@ -161,7 +161,8 @@ class NativeExecutor:
                         )
                 else:
                     exe = self.host.compile(mlir)
-                    self.compile_count += 1
+                    with self._lock:  # += is not atomic; keep exact
+                        self.compile_count += 1
                     entry = (exe, out_specs, out_tree)
                     exe_cache[shape_key] = entry
             if entry[0] == "jax":

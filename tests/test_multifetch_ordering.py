@@ -1,0 +1,137 @@
+"""Multi-fetch result routing across every execution path.
+
+Regression class for a real bug (round 4): with several fetches, a
+combine stage that re-feeds partials into the compiled callable must
+route them BY NAME — outputs arrive in fetch order while positional
+arguments follow the sorted feed names, and the two orders diverge as
+soon as names sort adversarially. The mesh reduce_blocks path once fed
+positionally and silently swapped results between fetches; every test
+was single-fetch, where the orders coincide.
+
+Fetch names here are chosen so fetch order (z, a) and sorted feed order
+(a_input, z_input) DISAGREE, and the two columns hold different
+constants so any swap changes the answer.
+"""
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.parallel import data_mesh, multihost as mh
+from tensorframes_tpu.schema import ScalarType, Shape
+
+Z, A = 2.0, 5.0
+N = 16
+
+
+def _frame(num_blocks=None, n=N):
+    kw = {"num_blocks": num_blocks} if num_blocks else {}
+    return tfs.TensorFrame.from_dict(
+        {
+            "z": np.full(n, Z, np.float32),
+            "a": np.full(n, A, np.float32),
+        },
+        **kw,
+    )
+
+
+def _fetches(df):
+    fz = dsl.reduce_sum(
+        tfs.block(df, "z", tf_name="z_input"), axes=[0]
+    ).named("z")
+    fa = dsl.reduce_sum(
+        tfs.block(df, "a", tf_name="a_input"), axes=[0]
+    ).named("a")
+    return [fz, fa]
+
+
+def _check(out, n=N):
+    assert float(out["z"]) == Z * n, out
+    assert float(out["a"]) == A * n, out
+
+
+class TestReduceBlocksRouting:
+    def test_host_multiblock(self):
+        df = _frame(num_blocks=4)
+        _check(tfs.reduce_blocks(_fetches(df), df))
+
+    def test_stream(self):
+        chunks = [_frame(n=4) for _ in range(4)]
+        out = tfs.reduce_blocks_stream(_fetches(chunks[0]), iter(chunks))
+        _check(out)
+
+    def test_mesh(self):
+        df = _frame()
+        _check(tfs.reduce_blocks(_fetches(df), df, mesh=data_mesh()))
+
+    def test_mesh_with_tail(self):
+        df = _frame(n=19)
+        _check(tfs.reduce_blocks(_fetches(df), df, mesh=data_mesh()), n=19)
+
+    def test_three_fetches(self):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "a": np.full(N, 1.0, np.float32),
+                "z": np.full(N, 2.0, np.float32),
+                "m": np.full(N, 3.0, np.float32),
+            }
+        )
+        fs = [
+            dsl.reduce_sum(
+                tfs.block(df, c, tf_name=f"{c}_input"), axes=[0]
+            ).named(c)
+            for c in ("z", "a", "m")  # fetch order != sorted order
+        ]
+        out = tfs.reduce_blocks(fs, df, mesh=data_mesh())
+        assert {k: float(v) for k, v in out.items()} == {
+            "z": 32.0, "a": 16.0, "m": 48.0,
+        }
+
+
+class TestAggregateRouting:
+    def _kframe(self):
+        return tfs.TensorFrame.from_dict(
+            {
+                "k": np.arange(N) % 2,
+                "z": np.full(N, Z, np.float32),
+                "a": np.full(N, A, np.float32),
+            }
+        )
+
+    def _check(self, out):
+        np.testing.assert_array_equal(out["z"].values, [Z * 8, Z * 8])
+        np.testing.assert_array_equal(out["a"].values, [A * 8, A * 8])
+
+    def test_host(self):
+        df = self._kframe()
+        self._check(tfs.aggregate(_fetches(df), tfs.group_by(df, "k")))
+
+    def test_mesh(self):
+        df = self._kframe()
+        self._check(
+            tfs.aggregate(_fetches(df), tfs.group_by(df, "k"), mesh=data_mesh())
+        )
+
+    def test_global(self):
+        df = self._kframe()
+        self._check(mh.aggregate_global(_fetches(df), tfs.group_by(df, "k")))
+
+
+class TestReduceRowsRouting:
+    def _graph(self):
+        z1 = dsl.placeholder(ScalarType.float32, Shape(()), name="z_1")
+        z2 = dsl.placeholder(ScalarType.float32, Shape(()), name="z_2")
+        a1 = dsl.placeholder(ScalarType.float32, Shape(()), name="a_1")
+        a2 = dsl.placeholder(ScalarType.float32, Shape(()), name="a_2")
+        return dsl.build([(z1 + z2).named("z"), (a1 + a2).named("a")])
+
+    def test_host(self):
+        g, fetches = self._graph()
+        _check(tfs.reduce_rows(g, _frame(), fetch_names=fetches))
+
+    def test_mesh(self):
+        g, fetches = self._graph()
+        _check(
+            tfs.reduce_rows(g, _frame(), fetch_names=fetches, mesh=data_mesh())
+        )

@@ -42,6 +42,7 @@ from ..graph.ir import Graph, parse_edge
 from ..ops.lowering import build_callable
 from .. import api as _api
 from ..runtime.executor import Executor, default_executor
+from ..runtime.retry import maybe_check_numerics
 
 __all__ = [
     "map_blocks",
@@ -161,6 +162,7 @@ def map_blocks(
             ),
         )
         outs = sharded(*_feeds(main))
+        maybe_check_numerics(fetch_list, outs, "map_blocks (mesh shards)")
         shard_out = None
         for f, o in zip(fetch_list, outs):
             if not trim and o.shape[0] != s * ndev:
@@ -180,6 +182,7 @@ def map_blocks(
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
         outs = tfn(*_feeds(tail))
+        maybe_check_numerics(fetch_list, outs, "map_blocks (mesh tail)")
         tail_out = None
         for f, o in zip(fetch_list, outs):
             if trim:
@@ -289,6 +292,7 @@ def reduce_blocks(
             np.stack([p[i] for p in partials]) for i in feed_src
         ]
         final = tuple(np.asarray(o) for o in tfn(*stacked))
+    maybe_check_numerics(fetch_list, list(final), "reduce_blocks (mesh)")
     if len(fetch_list) == 1:
         return final[0]
     return {_base(f): v for f, v in zip(fetch_list, final)}
@@ -400,6 +404,7 @@ def reduce_rows(
             np.stack([p[i] for p in partials]) for i in range(len(bases))
         ]
         final = tuple(np.asarray(o) for o in _jfold()(*stacked))
+    maybe_check_numerics(bases, list(final), "reduce_rows (mesh)")
     if len(bases) == 1:
         return final[0]
     return dict(zip(bases, final))
@@ -513,6 +518,7 @@ def aggregate(
             for c in tail_cols
         ]
         acc = [a + t if a.size else t for a, t in zip(acc, touts)]
+    maybe_check_numerics(bases, acc, "aggregate (mesh segment fast path)")
     for b, a in zip(bases, acc):
         results[b] = a
 

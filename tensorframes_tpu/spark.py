@@ -154,12 +154,23 @@ def _collected_frame(paths: Sequence[str]) -> TensorFrame:
         return frames[0]
     cols = {}
     for name in frames[0].columns:
-        # host_values covers string/object columns too (dense columns
-        # return their array unchanged) — group keys from Spark arrive
-        # as Arrow strings
-        cols[name] = np.concatenate(
-            [np.asarray(f.column(name).host_values()) for f in frames]
-        )
+        parts = [f.column(name) for f in frames]
+        if all(c.is_dense for c in parts) and (
+            len({c.values.shape[1:] for c in parts}) == 1
+        ):
+            cols[name] = np.concatenate([np.asarray(c.values) for c in parts])
+        elif not any(c.cell_shape.rank for c in parts):
+            # scalar string/object columns (group keys from Spark arrive
+            # as Arrow strings): one assembled host vector
+            cols[name] = np.concatenate(
+                [np.asarray(c.host_values()) for c in parts]
+            )
+        else:
+            # ragged rows (variable-length Arrow lists, or cell shapes
+            # differing across partitions): keep per-row cells — the
+            # verbs' ragged paths handle them like the reference's
+            # variable-length map_rows (`TFDataOps.scala:90-103`)
+            cols[name] = [np.asarray(r) for c in parts for r in c.rows()]
     out = TensorFrame.from_dict(cols)
     # one block per ingested chunk — the Spark partition boundaries
     offsets = [0]

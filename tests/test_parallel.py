@@ -359,6 +359,32 @@ class TestDistributedBindings:
         )
 
 
+class TestMeshCheckNumerics:
+    def test_nan_raises_on_mesh_map(self, mesh):
+        from tensorframes_tpu import config as tfs_config
+
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1.0, np.nan] * 8, dtype=np.float32)}
+        )
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        with tfs_config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="mesh"):
+                tfs.map_blocks(z, df, mesh=mesh)
+
+    def test_nan_raises_on_mesh_reduce(self, mesh):
+        from tensorframes_tpu import config as tfs_config
+
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1.0, np.inf] * 8, dtype=np.float32)}
+        )
+        s = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with tfs_config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="mesh"):
+                tfs.reduce_blocks(s, df, mesh=mesh)
+
+
 class TestMeshCompileCaching:
     """Round-3 verdict weak #4: the mesh aggregate seg_psum shard_map and
     the reduce_rows jfold tail combiners rebuilt a fresh jax.jit closure

@@ -342,6 +342,23 @@ class TestSparkAdapterPyarrowOnly:
         )
         assert got == [("a", 4.0), ("b", 6.0), ("c", 5.0)]
 
+    def test_ragged_rows_through_adapter(self, tmp_path):
+        # Variable-length Arrow list columns (the reference's
+        # variable-length map_rows case) must survive collection as
+        # ragged cells, not crash the dense concatenation.
+        import pyarrow as pa
+
+        import tensorframes_tpu.spark as tfspark
+
+        fake = _FakeSparkDF([
+            [pa.RecordBatch.from_pydict({"v": pa.array([[1.0, 2.0], [3.0]])})],
+            [pa.RecordBatch.from_pydict({"v": pa.array([[4.0, 5.0, 6.0]])})],
+        ])
+        out = tfspark.map_rows(
+            lambda v: {"s": v.sum()}, fake, ingest_dir=str(tmp_path / "rg")
+        )
+        assert out["s"].values.tolist() == [3.0, 3.0, 15.0]
+
     def test_empty_ingest_raises(self, tmp_path):
         import tensorframes_tpu.spark as tfspark
 

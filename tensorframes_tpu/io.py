@@ -16,7 +16,14 @@ from typing import Iterator, Optional
 
 from .frame import TensorFrame
 
-__all__ = ["write_arrow_ipc", "read_arrow_ipc", "stream_arrow_ipc"]
+__all__ = [
+    "write_arrow_ipc",
+    "read_arrow_ipc",
+    "stream_arrow_ipc",
+    "write_parquet",
+    "read_parquet",
+    "stream_parquet",
+]
 
 
 def write_arrow_ipc(frame: TensorFrame, path: str) -> None:
@@ -37,6 +44,21 @@ def write_arrow_ipc(frame: TensorFrame, path: str) -> None:
                 )
 
 
+def _frame_with_offsets(table, row_counts, num_blocks):
+    """Shared tail of the file readers: ``num_blocks`` repartitions;
+    otherwise the file's own chunking (record batches / row groups)
+    becomes the block structure when it accounts for every row."""
+    if num_blocks is not None:
+        return TensorFrame.from_arrow(table, num_blocks=num_blocks)
+    frame = TensorFrame.from_arrow(table)
+    offsets = [0]
+    for n in row_counts:
+        offsets.append(offsets[-1] + n)
+    if offsets[-1] == frame.nrows and len(offsets) > 2:
+        frame.offsets = offsets
+    return frame
+
+
 def read_arrow_ipc(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
     """Read a whole Arrow IPC file into one frame (record batches become
     blocks unless ``num_blocks`` repartitions)."""
@@ -49,15 +71,7 @@ def read_arrow_ipc(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
         ]
         table = pa.Table.from_batches(batches, schema=reader.schema)
         batch_rows = [b.num_rows for b in batches]
-    if num_blocks is not None:
-        return TensorFrame.from_arrow(table, num_blocks=num_blocks)
-    frame = TensorFrame.from_arrow(table)
-    offsets = [0]
-    for n in batch_rows:
-        offsets.append(offsets[-1] + n)
-    if offsets[-1] == frame.nrows and len(offsets) > 2:
-        frame.offsets = offsets
-    return frame
+    return _frame_with_offsets(table, batch_rows, num_blocks)
 
 
 def stream_arrow_ipc(
@@ -80,3 +94,63 @@ def stream_arrow_ipc(
                 for bi in range(start, min(start + batches_per_frame, n))
             ]
             yield TensorFrame.from_arrow(pa.Table.from_batches(group))
+
+
+# ---------------------------------------------------------------------------
+# Parquet — the lake format Spark pipelines actually store (the reference
+# read its DataFrames from whatever Spark loaded, commonly Parquet); row
+# groups map to frame blocks the way IPC record batches do.
+# ---------------------------------------------------------------------------
+
+
+def write_parquet(frame: TensorFrame, path: str) -> None:
+    """Write a frame as Parquet, one row group per block so the block
+    structure survives the round trip (zero-row blocks cannot: Parquet
+    forbids empty row groups). ``row_group_size`` pins each group to the
+    block's full row count — without it pyarrow splits blocks larger
+    than its 1Mi-row default into several groups."""
+    import pyarrow.parquet as pq
+
+    table = frame.to_arrow()
+    writer = pq.ParquetWriter(path, table.schema)
+    try:
+        for bi in range(frame.num_blocks):
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            if hi > lo:
+                writer.write_table(
+                    table.slice(lo, hi - lo), row_group_size=hi - lo
+                )
+    finally:
+        writer.close()
+
+
+def read_parquet(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
+    """Read a whole Parquet file into one frame (row groups become
+    blocks unless ``num_blocks`` repartitions)."""
+    import pyarrow.parquet as pq
+
+    with pq.ParquetFile(path) as pf:
+        table = pf.read()
+        # row counts come from metadata — no per-group decode needed
+        group_rows = [
+            pf.metadata.row_group(i).num_rows
+            for i in range(pf.metadata.num_row_groups)
+        ]
+    return _frame_with_offsets(table, group_rows, num_blocks)
+
+
+def stream_parquet(
+    path: str, row_groups_per_frame: int = 1
+) -> Iterator[TensorFrame]:
+    """Lazily yield one frame per ``row_groups_per_frame`` row groups —
+    bounded host memory regardless of file size, the Parquet twin of
+    `stream_arrow_ipc` (feed to `reduce_blocks_stream`)."""
+    import pyarrow.parquet as pq
+
+    if row_groups_per_frame < 1:
+        raise ValueError("row_groups_per_frame must be >= 1")
+    with pq.ParquetFile(path) as pf:
+        n = pf.num_row_groups
+        for start in range(0, n, row_groups_per_frame):
+            idx = list(range(start, min(start + row_groups_per_frame, n)))
+            yield TensorFrame.from_arrow(pf.read_row_groups(idx))

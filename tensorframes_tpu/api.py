@@ -748,6 +748,7 @@ def map_rows(
     feed_dict: Optional[Dict[str, str]] = None,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> TensorFrame:
     """Apply a graph independently to every row.
 
@@ -757,37 +758,64 @@ def map_rows(
     per row (`performMapRows`, `DebugRowOps.scala:826-864`). Ragged columns
     fall back to a per-row loop (compile-cached per distinct cell shape),
     the moral equivalent of the reference's variable-length row support
-    (`TFDataOps.scala:90-103`).
+    (`TFDataOps.scala:90-103`). ``bindings`` holds per-call bound
+    placeholders constant across all rows (vmap in_axes=None), the same
+    jit-argument semantics as map_blocks bindings.
     """
     ex = executor or default_executor()
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
-        return _map_rows_fn(fetches, frame)
+        return _map_rows_fn(fetches, frame, bindings=bindings)
     graph, fetch_list = _as_graph(fetches, fetch_names)
     graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
     if str_pass:
         str_cols = _string_passthrough_columns(str_pass, frame, feed_dict)
         if fetch_list:
-            dev = map_rows(graph, frame, feed_dict, fetch_list, executor)
+            dev = map_rows(
+                graph, frame, feed_dict, fetch_list, executor,
+                bindings=bindings,
+            )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
             dev_cols = []
         return _output_frame(frame, dev_cols + str_cols, append_input=True)
-    overrides = _ph_overrides(graph, frame, feed_dict, block_level=False)
+    overrides = _ph_overrides(
+        graph, frame, feed_dict, block_level=False, bindings=bindings
+    )
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
-    mapping = _match_columns(summary, frame, feed_dict, block_level=False)
+    _check_bindings(summary, bindings)
+    mapping = _match_columns(
+        summary, frame, feed_dict, block_level=False, bindings=bindings
+    )
     params = sorted(summary.inputs)
-    cols_used = [mapping[p] for p in params]
+    col_params = [p for p in params if p not in bindings]
+    cols_used = [mapping[p] for p in col_params]
     out_names = [_base(f) for f in fetch_list]
     dense = all(frame.column(c).is_dense for c in cols_used)
+    if bindings and not dense:
+        raise ValueError(
+            "map_rows: bindings are not supported with ragged feed "
+            "columns; densify the columns or bake the values as constants"
+        )
+    if bindings and not col_params:
+        raise ValueError(
+            "map_rows: every placeholder is bound, so nothing varies per "
+            "row; use map_blocks (or run the graph once and broadcast)"
+        )
 
     if dense:
+        in_axes = tuple(None if p in bindings else 0 for p in params)
+        bind_sig = ",".join(sorted(bindings))
         vfn = ex.cached(
-            "vmap-rows",
+            f"vmap-rows-[{bind_sig}]" if bindings else "vmap-rows",
             graph,
             fetch_list,
             params,
             lambda: jax.jit(
-                jax.vmap(build_callable(graph, fetch_list, params))
+                jax.vmap(
+                    build_callable(graph, fetch_list, params),
+                    in_axes=in_axes,
+                )
             ),
         )
         acc: Dict[str, List[np.ndarray]] = {n: [] for n in out_names}
@@ -795,7 +823,14 @@ def map_rows(
             lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
             if lo == hi:
                 continue
-            outs = vfn(*[frame.column(c).values[lo:hi] for c in cols_used])
+            outs = vfn(
+                *[
+                    bindings[p]
+                    if p in bindings
+                    else frame.column(mapping[p]).values[lo:hi]
+                    for p in params
+                ]
+            )
             maybe_check_numerics(out_names, outs, f"map_rows block {bi}")
             for n, o in zip(out_names, outs):
                 acc[n].append(o)
@@ -837,32 +872,61 @@ def map_rows(
     return _output_frame(frame, out_cols, append_input=True)
 
 
-def _map_rows_fn(fn: Callable, frame: TensorFrame) -> TensorFrame:
+def _map_rows_fn(
+    fn: Callable,
+    frame: TensorFrame,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
+) -> TensorFrame:
     """Function front-end for map_rows: fn(cell, ...) -> dict of outputs.
 
     jit/vmap preserve dict outputs, so output names come from the traced
     dict directly — the user function is invoked exactly once per trace.
+    ``bindings`` match function PARAMETER names and are held constant
+    across rows (vmap in_axes=None), like the graph front-end.
     """
-    params = _fn_feed_columns(fn, frame)
-    dense = all(frame.column(p).is_dense for p in params)
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
+    params = _fn_feed_columns(fn, frame, bound=set(bindings))
+    unknown = sorted(set(bindings) - set(params))
+    if unknown:
+        raise ValueError(
+            f"bindings {unknown} do not match any function parameter "
+            f"(parameters: {params})"
+        )
+    col_params = [p for p in params if p not in bindings]
+    if bindings and not col_params:
+        raise ValueError(
+            "map_rows: every parameter is bound, so nothing varies per "
+            "row; use map_blocks (or call the function directly)"
+        )
+    dense = all(frame.column(p).is_dense for p in col_params)
+    if bindings and not dense:
+        raise ValueError(
+            "map_rows: bindings are not supported with ragged feed "
+            "columns; densify the columns or bake the values as constants"
+        )
 
     def wrapped(*cells):
         return _fn_outputs_to_dict(fn(*cells), "map_rows")
 
+    def _feeds(lo, hi):
+        return [
+            bindings[p] if p in bindings else frame.column(p).values[lo:hi]
+            for p in params
+        ]
+
     acc: Dict[str, List[np.ndarray]] = {}
     if dense:
-        vfn = jax.jit(jax.vmap(wrapped))
+        in_axes = tuple(None if p in bindings else 0 for p in params)
+        vfn = jax.jit(jax.vmap(wrapped, in_axes=in_axes))
         for bi in range(frame.num_blocks):
             lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
             if lo == hi:
                 continue
-            outs = vfn(*[frame.column(p).values[lo:hi] for p in params])
+            outs = vfn(*_feeds(lo, hi))
             for n, o in outs.items():
                 acc.setdefault(n, []).append(o)
         if not acc:
-            empties = _empty_fn_outputs(
-                vfn, [frame.column(p).values[:0] for p in params]
-            )
+            empties = _empty_fn_outputs(vfn, _feeds(0, 0))
             acc = {n: [v] for n, v in empties.items()}
         out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
     else:

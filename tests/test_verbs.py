@@ -698,6 +698,49 @@ class TestReduceBlocksStream:
 class TestBindings:
     """Per-call bound placeholders: jit arguments, not baked constants."""
 
+    def test_map_rows_bindings(self):
+        from tensorframes_tpu.runtime.executor import default_executor
+
+        p = dsl.placeholder(ScalarType.float64, Shape(()), name="v")
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        df = frame_of(v=np.arange(4.0))
+        y = (p * w).named("y")
+        out = tfs.map_rows(y, df, bindings={"w": np.float64(10.0)})
+        np.testing.assert_array_equal(out["y"].values, np.arange(4.0) * 10)
+        # rebinding reuses the compiled executable
+        n = default_executor().compile_count
+        out2 = tfs.map_rows(y, df, bindings={"w": np.float64(-1.0)})
+        assert default_executor().compile_count == n
+        np.testing.assert_array_equal(out2["y"].values, np.arange(4.0) * -1)
+
+    def test_map_rows_fn_front_end_bindings(self):
+        df = frame_of(v=np.arange(4.0))
+        out = tfs.map_rows(
+            lambda v, w: {"y": v * w}, df, bindings={"w": np.float64(7.0)}
+        )
+        np.testing.assert_array_equal(out["y"].values, np.arange(4.0) * 7)
+
+    def test_map_rows_all_bound_rejected(self):
+        df = frame_of(v=np.arange(4.0))
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        with pytest.raises(ValueError, match="every placeholder is bound"):
+            tfs.map_rows(
+                (w * 2.0).named("y"), df, bindings={"w": np.float64(1.0)}
+            )
+
+    def test_map_rows_bindings_ragged_rejected(self):
+        p = dsl.placeholder(ScalarType.float64, Shape((None,)), name="v")
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        df = tfs.TensorFrame.from_dict(
+            {"v": [np.arange(2.0), np.arange(3.0)]}
+        )
+        with pytest.raises(ValueError, match="ragged"):
+            tfs.map_rows(
+                dsl.reduce_sum(p * w, axes=[0]).named("y"),
+                df,
+                bindings={"w": np.float64(2.0)},
+            )
+
     def test_dsl_graph_binding(self):
         df = frame_of(x=np.array([1.0, 2.0, 3.0]))
         x = tfs.block(df, "x")

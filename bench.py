@@ -125,19 +125,36 @@ def _reap_stale_claimants(reap_all: bool = False) -> int:
     return len(pids)
 
 
+# Staged probe: each marker flushes BEFORE the next step, so a hang's
+# stderr tail names the exact stage that wedged (a bare hang used to
+# record an empty tail — "wedged-grant" with zero evidence).
+_PROBE_CHILD = """
+import sys, time
+t0 = time.time()
+def stage(msg):
+    print(f"stage[{time.time()-t0:.1f}s]: {msg}", file=sys.stderr, flush=True)
+stage("importing jax")
+import jax
+stage("jax imported; creating backend client (device grant)")
+ds = jax.devices()
+stage(f"devices ready: {[getattr(d, 'device_kind', d.platform) for d in ds]}")
+"""
+
+
 def _probe(timeout_s: float):
     """Probe accelerator init in a CHILD process: a wedged chip claim
     hangs `jax.devices()` indefinitely, and that must not hang the
     bench. Returns ``(status, stderr_tail)`` where status is one of
     ``ok`` / ``hang`` / ``init-error`` — the child's stderr is KEPT
-    (round-2 weakness: three failed probes recorded zero evidence)."""
+    (round-2 weakness: three failed probes recorded zero evidence), and
+    staged markers pinpoint where a hang stopped."""
     import tempfile
 
     from tensorframes_tpu.runtime.pjrt_host import wait_or_terminate
 
     with tempfile.TemporaryFile(mode="w+") as errf:
         proc = subprocess.Popen(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", _PROBE_CHILD],
             stdout=subprocess.DEVNULL,
             stderr=errf,
         )
@@ -152,7 +169,7 @@ def _probe(timeout_s: float):
     if rc == 0:
         return "ok", tail
     if rc is None:
-        return "hang", tail
+        return "hang", f"hung after {timeout_s:.0f}s at last stage: {tail}"
     return "init-error", tail
 
 

@@ -30,6 +30,11 @@ SMOKE_SIZES = {
     "INCEPTIONV3_IMAGES": "4",
     "INCEPTIONV3_SIZE": "75",
     "RAGGED_ROWS": "20000",
+    "TRAIN_DMODEL": "64",
+    "TRAIN_LAYERS": "2",
+    "TRAIN_SEQ": "32",
+    "TRAIN_BATCH": "2",
+    "TRAIN_STEPS": "3",
     "RAGGED_LOOP_ROWS": "500",
     "OVERLAP_CHUNK_ROWS": "200000",
     "OVERLAP_CHUNKS": "6",
@@ -54,6 +59,10 @@ def main():
         "frozen_inception_v3_bench",
         "ragged_map_rows_bench",
         "stream_overlap_bench",
+        # LAST: on a 1-CPU-device host this retargets the process to a
+        # virtual 8-device mesh (clear_backends), which must not leak
+        # into any bench that runs after it
+        "train_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
 

@@ -1,0 +1,101 @@
+"""Training throughput benchmark: the 3D-parallel TransformerLM step.
+
+The reference has no training story at all (frozen inference graphs
+only); this framework's training path — DP x SP x TP in ONE jitted step
+(`models.TransformerLM.sharded_train_step_3d`: batch over data, ring
+attention over seq, Megatron column/row splits over model) — is the
+capability SURVEY.md §2.5 says the rebuild must make first-class.
+Reports steady-state tokens/s with compile excluded.
+
+Sizes: TRAIN_DMODEL (256), TRAIN_LAYERS (4), TRAIN_SEQ per shard (128),
+TRAIN_BATCH per data shard (8), TRAIN_STEPS (10), mesh TRAIN_DP x
+TRAIN_SP x TRAIN_MP (2x2x2 — runs on the 8-device virtual CPU mesh
+anywhere; on a real slice the same code spans chips).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def main():
+    dp = scaled("TRAIN_DP", 2)
+    sp = scaled("TRAIN_SP", 2)
+    mp = scaled("TRAIN_MP", 2)
+    n = dp * sp * mp
+
+    import jax
+
+    if len(jax.devices()) < n:
+        if jax.devices()[0].platform != "cpu":
+            # a single-accelerator host must NOT retarget the process to
+            # a virtual CPU mesh — that would silently move every LATER
+            # bench in the same run off the chip. Multi-chip training is
+            # dryrun-verified separately (__graft_entry__.dryrun_multichip).
+            print(
+                f"# train_bench skipped: needs {n} devices, host has "
+                f"{len(jax.devices())} {jax.devices()[0].platform} device(s)",
+                file=sys.stderr,
+            )
+            return
+        from tensorframes_tpu.utils import force_virtual_cpu_devices
+
+        force_virtual_cpu_devices(n)
+        import jax  # noqa: F811 — same module, devices refreshed
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tensorframes_tpu.models import TransformerLM
+
+    d_model = scaled("TRAIN_DMODEL", 256)
+    layers = scaled("TRAIN_LAYERS", 4)
+    seq_shard = scaled("TRAIN_SEQ", 128)
+    batch_shard = scaled("TRAIN_BATCH", 8)
+    steps = scaled("TRAIN_STEPS", 10)
+
+    mesh = Mesh(
+        np.asarray(jax.devices()[:n]).reshape(dp, sp, mp),
+        ("data", "seq", "model"),
+    )
+    model = TransformerLM(
+        vocab=256,
+        d_model=d_model,
+        n_heads=max(4, mp * 2),
+        n_layers=layers,
+        max_seq=sp * seq_shard,
+    )
+    step = model.sharded_train_step_3d(mesh, lr=0.1)
+    layout = model.device_layout(model.params)
+
+    batch = dp * batch_shard
+    seq = sp * seq_shard
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 256, (batch, seq)), jnp.int32)
+
+    layout, loss = step(layout, toks)  # warm-up: compile excluded
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        layout, loss = step(layout, toks)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_s = batch * seq * steps / dt
+    emit(
+        f"TransformerLM 3D train step (dp{dp}xsp{sp}xtp{mp}, "
+        f"{batch}x{seq}, d{d_model}L{layers})",
+        tokens_s,
+        "tokens/s",
+    )
+
+
+if __name__ == "__main__":
+    main()

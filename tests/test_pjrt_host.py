@@ -268,6 +268,56 @@ class TestNativeMeshExecution:
         assert float(total) == np.arange(19.0).sum()
         assert ex._jax_fallback_unused()
 
+    def test_mesh_bindings_native(self, mesh_host):
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+        from tensorframes_tpu.parallel import data_mesh
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        ex = _executor_on(mesh_host)
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        w = dsl.placeholder(ScalarType.float64, Shape(()), name="w")
+        z = (tfs.block(df, "x") * w).named("z")
+        o = tfs.map_blocks(
+            z, df, mesh=data_mesh(), executor=ex,
+            bindings={"w": np.float64(3.0)},
+        )
+        np.testing.assert_array_equal(
+            np.asarray(o["z"].values), np.arange(16.0) * 3.0
+        )
+        n = ex.compile_count
+        o2 = tfs.map_blocks(
+            z, df, mesh=data_mesh(), executor=ex,
+            bindings={"w": np.float64(-1.0)},
+        )
+        assert ex.compile_count == n  # rebind reuses the SPMD executable
+        np.testing.assert_array_equal(
+            np.asarray(o2["z"].values), np.arange(16.0) * -1.0
+        )
+        assert ex._jax_fallback_unused()
+
+    def test_mesh_multi_fetch_native(self, mesh_host):
+        # the round-4 combine-routing fix, verified through the plugin's
+        # SPMD execution too
+        import tensorframes_tpu as tfs
+        from tensorframes_tpu import dsl
+        from tensorframes_tpu.parallel import data_mesh
+
+        ex = _executor_on(mesh_host)
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(16.0), "n": np.ones(16)}
+        )
+        s1 = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        s2 = dsl.reduce_sum(
+            tfs.block(df, "n", tf_name="n_input"), axes=[0]
+        ).named("n")
+        out = tfs.reduce_blocks([s1, s2], df, mesh=data_mesh(), executor=ex)
+        assert float(out["x"]) == 120.0
+        assert float(out["n"]) == 16.0
+        assert ex._jax_fallback_unused()
+
     def test_single_device_host_still_refuses_mesh(self, host):
         import tensorframes_tpu as tfs
         from tensorframes_tpu.parallel import data_mesh

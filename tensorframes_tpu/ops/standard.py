@@ -87,6 +87,15 @@ def _noop(ctx, node, inputs):
     return ()
 
 
+@register("Assert")
+def _assert(ctx, node, inputs):
+    # Runtime assertions are host-side control flow TF threads through
+    # the graph (frozen BERT carries seq-length Asserts); under XLA the
+    # shapes they guard are compile-time facts, so the node reduces to
+    # its control-dependency role — like NoOp, it produces nothing.
+    return ()
+
+
 # ---------------------------------------------------------------------------
 # elementwise unary
 # ---------------------------------------------------------------------------
@@ -117,6 +126,7 @@ _UNARY = {
     "Softplus": jax.nn.softplus,
     "Softsign": jax.nn.soft_sign,
     "Erf": jax.scipy.special.erf,
+    "Erfc": jax.scipy.special.erfc,
     "Sin": jnp.sin,
     "Cos": jnp.cos,
     "Tan": jnp.tan,

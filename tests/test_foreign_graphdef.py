@@ -200,46 +200,69 @@ class TestFrozenConvNetEndToEnd:
         )
 
 
-def _freeze_via_subprocess(model: str, hw: int, batch: int, tmpdir):
-    """Freeze a Keras model and score a reference batch in a CHILD
-    process: TF2 freezing needs eager mode, and toggling
+def _run_freeze_child(body: str, tmpdir: str, tag: str):
+    """Run a freeze snippet in a CHILD process and load its outputs:
+    TF2 freezing needs eager mode, and toggling
     enable/disable_eager_execution in-process is order-fragile (it
     raises once graph mode has been used — which the tf1 session tests
-    in this module do). InceptionV3 goes through the SAME shared helper
-    the benchmark uses, so the graph measured there is byte-identical
-    to the graph validated here. Returns (wire, in_node, out_node,
-    images, expected)."""
+    in this module do). ``body`` must define ``wire`` (GraphDef bytes),
+    ``innode``/``outnode`` (strings), ``feeds`` (the input batch) and
+    ``expected`` (TF's outputs for it).
+
+    A child that dies on a missing optional dependency (ImportError /
+    ModuleNotFoundError in its stderr) SKIPS; any other failure raises —
+    a real freeze/importer regression must not masquerade as a green
+    skip. Returns (wire, in_node, out_node, feeds, expected)."""
     import subprocess
     import sys
 
-    pb = os.path.join(tmpdir, f"{model}.pb")
-    npz = os.path.join(tmpdir, f"{model}.npz")
+    pb = os.path.join(tmpdir, f"{tag}.pb")
+    npz = os.path.join(tmpdir, f"{tag}.npz")
     code = (
         "import os\n"
         "os.environ.setdefault('CUDA_VISIBLE_DEVICES','-1')\n"
         "os.environ.setdefault('TF_CPP_MIN_LOG_LEVEL','2')\n"
         "import numpy as np\n"
-        "from benchmarks._util import freeze_keras_model\n"
-        f"wire, innode, outnode, score = freeze_keras_model({model!r}, {hw})\n"
-        "rng = np.random.default_rng(0)\n"
-        f"images = rng.normal(size=({batch},{hw},{hw},3))"
-        ".astype(np.float32)\n"
-        "expected = score(images)\n"
-        f"open({pb!r},'wb').write(wire)\n"
-        f"np.savez({npz!r}, images=images, expected=expected,\n"
+        + body
+        + f"open({pb!r},'wb').write(wire)\n"
+        f"np.savez({npz!r}, feeds=feeds, expected=expected,\n"
         "         innode=innode, outnode=outnode)\n"
     )
-    subprocess.run(
-        [sys.executable, "-c", code], check=True, timeout=600,
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=600,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    if proc.returncode != 0:
+        err = proc.stderr or ""
+        if "ImportError" in err or "ModuleNotFoundError" in err:
+            tail = err.strip().splitlines()[-1] if err.strip() else "<no stderr>"
+            pytest.skip(f"freeze child missing optional dep: {tail[:160]}")
+        raise RuntimeError(
+            f"freeze child failed (rc={proc.returncode}): {err[-400:]}"
+        )
     with open(pb, "rb") as f:
         wire = f.read()
     d = np.load(npz)
     return (
         wire, str(d["innode"]), str(d["outnode"]),
-        d["images"], d["expected"],
+        d["feeds"], d["expected"],
     )
+
+
+def _freeze_via_subprocess(model: str, hw: int, batch: int, tmpdir):
+    """Keras-zoo freeze through the SAME shared helper the benchmark
+    uses, so the graph measured there is byte-identical to the graph
+    validated here."""
+    body = (
+        "from benchmarks._util import freeze_keras_model\n"
+        f"wire, innode, outnode, score = freeze_keras_model({model!r}, {hw})\n"
+        "rng = np.random.default_rng(0)\n"
+        f"feeds = rng.normal(size=({batch},{hw},{hw},3))"
+        ".astype(np.float32)\n"
+        "expected = score(feeds)\n"
+    )
+    return _run_freeze_child(body, tmpdir, model)
 
 
 class TestFrozenKerasInceptionV3:
@@ -283,6 +306,50 @@ class TestFrozenKerasInceptionV3:
         np.testing.assert_array_equal(
             ours.argmax(axis=1), expected.argmax(axis=1)
         )
+
+
+class TestFrozenBert:
+    """A frozen TRANSFORMER through the importer: HuggingFace TF-BERT
+    (BatchMatMulV2 attention, GatherV2 embeddings, LayerNorm via
+    Mean/SquaredDifference/Rsqrt, Erfc GELU, graph-threaded Asserts) —
+    the architecture family none of the conv zoo exercises. Frozen in a
+    subprocess like the zoo; skips cleanly if transformers' deprecated
+    TF classes are unavailable."""
+
+    def test_scores_match_tf(self, tmp_path):
+        body = (
+            "import tensorflow as tf\n"
+            "from transformers import TFBertModel, BertConfig\n"
+            "from tensorflow.python.framework.convert_to_constants import "
+            "convert_variables_to_constants_v2\n"
+            "tf.keras.utils.set_random_seed(7)\n"
+            "cfg = BertConfig(vocab_size=1000, hidden_size=64,"
+            " num_hidden_layers=2, num_attention_heads=4,"
+            " intermediate_size=128, max_position_embeddings=64)\n"
+            "m = TFBertModel(cfg)\n"
+            "feeds = np.random.RandomState(0).randint(0, 1000, (3, 16))"
+            ".astype(np.int32)\n"
+            "_ = m(tf.constant(feeds))\n"
+            "fn = tf.function(lambda x: m(x).last_hidden_state)\n"
+            "cf = fn.get_concrete_function("
+            "tf.TensorSpec([None, 16], tf.int32))\n"
+            "fr = convert_variables_to_constants_v2(cf)\n"
+            "expected = fr(tf.constant(feeds))\n"
+            "expected = (expected[0] if isinstance(expected,(list,tuple)) "
+            "else expected).numpy()\n"
+            "wire = fr.graph.as_graph_def().SerializeToString()\n"
+            "innode = fr.inputs[0].name.split(':')[0]\n"
+            "outnode = fr.outputs[0].name.split(':')[0]\n"
+        )
+        wire, in_node, out_node, ids, expected = _run_freeze_child(
+            body, str(tmp_path), "bert"
+        )
+        df = tfs.TensorFrame.from_dict({"ids": ids})
+        out = tfs.map_blocks(
+            wire, df, fetch_names=[out_node], feed_dict={in_node: "ids"}
+        )
+        ours = np.asarray(out[out_node].values)
+        np.testing.assert_allclose(ours, expected, rtol=1e-4, atol=1e-5)
 
 
 class TestFrozenKerasZoo:

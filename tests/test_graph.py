@@ -190,6 +190,27 @@ class TestLowering:
         with pytest.raises(GraphLoweringError, match="unsupported op"):
             build_callable(g, ["w"], [])
 
+    def test_assert_is_control_only_and_erfc_lowers(self):
+        # TF-free pin of the BERT-motivated lowerings: Assert reduces to
+        # its control-dependency role (shapes it guards are compile-time
+        # facts under XLA), Erfc matches 1 - erf.
+        from tensorframes_tpu.proto.graphdef import AttrValue
+
+        g = Graph([
+            GraphNode("x", "Placeholder", [], {
+                "dtype": AttrValue.of_type(ScalarType.float32)}),
+            GraphNode("ok", "Assert", ["^x"]),
+            GraphNode("e", "Erfc", ["x", "^ok"]),
+        ])
+        fn = jax.jit(build_callable(g, ["e"], ["x"]))
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        (out,) = fn(x)
+        from scipy.special import erfc as scipy_erfc
+
+        np.testing.assert_allclose(
+            np.asarray(out), scipy_erfc(x), rtol=1e-6
+        )
+
     def test_shape_arithmetic_chain_constant_folds_under_jit(self):
         # Keras squeeze-excite pattern: Reshape's target comes from
         # Shape -> StridedSlice -> Pack. Under jit the first jnp op in

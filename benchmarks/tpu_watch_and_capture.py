@@ -1,0 +1,125 @@
+"""Detached chip-claim watcher: probe the (frequently wedged) tunnel TPU
+in bounded child processes, and fire the full on-chip capture the moment
+a probe succeeds.
+
+Round-4 verdict task 1: the shared chip stayed wedged at device grant for
+most of two rounds, and end-of-round capture attempts missed the brief
+healthy windows.  This watcher makes capture an ambient process: it is
+launched detached (``nohup``) at the START of the round, probes
+claimability every ``TFS_WATCH_INTERVAL_S`` (default 120s) in a child
+with a hard timeout, and on the first successful probe runs
+``benchmarks/capture_tpu.py <round>`` (which writes the internally
+consistent ``BENCH_TPU_r{N}.json`` in one session).
+
+Discipline (see bench.py::_probe / _reap_stale_claimants):
+- NEVER call ``jax.devices()`` in this process — only in children.
+- SIGTERM with a grace wait, never SIGKILL mid-claim (force-killing a
+  claimant is what leaks device grants in the first place).
+- The watcher MUST be killed before the driver's end-of-round bench run
+  (``pkill -f tpu_watch_and_capture``) so it is not mistaken for a live
+  co-tenant chip holder.
+
+Usage:  nohup python benchmarks/tpu_watch_and_capture.py 5 \
+            >> benchmarks/tpu_watch.log 2>&1 &
+Exits 0 after a successful capture (DONE marker written); keeps watching
+after a failed capture attempt (the chip can re-wedge mid-capture).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Staged markers so a hang's log line names the exact stage that wedged.
+_PROBE_CHILD = """
+import sys, time
+t0 = time.time()
+def stage(msg):
+    print(f"stage[{time.time()-t0:.1f}s]: {msg}", file=sys.stderr, flush=True)
+stage("importing jax")
+import jax
+stage("jax imported; creating backend client (device grant)")
+ds = jax.devices()
+stage(f"devices ready: {[getattr(d, 'device_kind', d.platform) for d in ds]}")
+print(ds[0].platform)
+"""
+
+
+def _log(msg: str) -> None:
+    ts = datetime.datetime.now().strftime("%H:%M:%S")
+    print(f"[{ts}] {msg}", flush=True)
+
+
+def _wait_or_terminate(proc: subprocess.Popen, timeout_s: float):
+    """Wait up to ``timeout_s``; on timeout SIGTERM and grace-wait 20s.
+    Returns the return code, or None if the child had to be terminated."""
+    try:
+        return proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            # Last resort only AFTER the grace period: a SIGTERM-deaf
+            # child blocked in the driver would otherwise pin the PTY.
+            proc.kill()
+            proc.wait()
+        return None
+
+
+def _probe(timeout_s: float):
+    import tempfile
+
+    with tempfile.TemporaryFile(mode="w+") as errf, \
+            tempfile.TemporaryFile(mode="w+") as outf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD], stdout=outf, stderr=errf,
+        )
+        rc = _wait_or_terminate(proc, timeout_s)
+        errf.seek(0)
+        outf.seek(0)
+        platform = outf.read().strip()
+        lines = [ln.strip() for ln in errf.read().splitlines() if ln.strip()]
+        tail = " | ".join(lines[-2:])
+    if rc == 0:
+        return ("ok-tpu" if platform == "tpu" else "ok-other"), tail
+    return ("hang" if rc is None else "error"), tail
+
+
+def main(round_no: int) -> int:
+    interval = float(os.environ.get("TFS_WATCH_INTERVAL_S", 120))
+    probe_s = float(os.environ.get("TFS_WATCH_PROBE_S", 90))
+    out_json = os.path.join(ROOT, f"BENCH_TPU_r{round_no:02d}.json")
+    done_marker = os.path.join(ROOT, "benchmarks", f".capture_done_r{round_no}")
+    _log(f"watcher up: round={round_no} interval={interval}s probe={probe_s}s")
+    attempt = 0
+    while True:
+        attempt += 1
+        status, tail = _probe(probe_s)
+        _log(f"probe {attempt}: {status} ({tail or 'no output'})")
+        if status == "ok-tpu":
+            _log("chip healthy — launching capture_tpu.py")
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join("benchmarks", "capture_tpu.py"),
+                 str(round_no)],
+                cwd=ROOT,
+            )
+            rc = _wait_or_terminate(
+                proc, float(os.environ.get("TFS_CAPTURE_TIMEOUT_S", 14400)))
+            if rc == 0 and os.path.exists(out_json):
+                with open(done_marker, "w") as f:
+                    f.write(datetime.datetime.now().isoformat())
+                _log(f"capture complete: {out_json}; watcher exiting")
+                return 0
+            _log(f"capture attempt failed (rc={rc}); resuming watch")
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 5))

@@ -520,6 +520,12 @@ PJRT_Error* execute_spmd(PJRT_LoadedExecutable_Execute_Args* args) {
     return make_error("SPMD executable output arity mismatch");
   }
 
+  // Stage every output locally and publish to args->output_lists only
+  // once ALL of them assembled: the host treats an errored call as
+  // producing nothing, so buffers published before a mid-loop failure
+  // would leak (round-4 advisor finding).
+  std::vector<std::unique_ptr<PJRT_Buffer>> staged;
+  staged.reserve(outs[0].size());
   for (size_t i = 0; i < outs[0].size(); i++) {
     const std::vector<int64_t>& sdims = le->out_shard_dims[i];
     const std::vector<int64_t>& gdims = le->out_global_dims[i];
@@ -538,7 +544,7 @@ PJRT_Error* execute_spmd(PJRT_LoadedExecutable_Execute_Args* args) {
             "replication and contiguous lead-axis slicing are supported)")));
       }
     }
-    auto* b = new PJRT_Buffer();
+    auto b = std::make_unique<PJRT_Buffer>();
     if (sdims == gdims) {
       // replicated result: device 0's copy IS the global value
       b->cpp = std::move(outs[0][i]);
@@ -554,7 +560,6 @@ PJRT_Error* execute_spmd(PJRT_LoadedExecutable_Execute_Args* args) {
       for (int64_t d = 0; d < n; d++) {
         auto ref_or = outs[d][i]->AcquireExternalReference();
         if (!ref_or.ok()) {
-          delete b;
           return make_error(ref_or.status());
         }
         std::memcpy(host.data() + d * shard_bytes,
@@ -563,7 +568,6 @@ PJRT_Error* execute_spmd(PJRT_LoadedExecutable_Execute_Args* args) {
       }
       auto mem_or = devices[0]->default_memory_space();
       if (!mem_or.ok()) {
-        delete b;
         return make_error(mem_or.status());
       }
       std::optional<absl::Span<int64_t const>> strides;
@@ -573,13 +577,15 @@ PJRT_Error* execute_spmd(PJRT_LoadedExecutable_Execute_Args* args) {
           /*on_done_with_host_buffer=*/nullptr, mem_or.value(),
           /*device_layout=*/nullptr);
       if (!buf_or.ok()) {
-        delete b;
         return make_error(buf_or.status());
       }
       b->cpp = std::move(buf_or).value();
       b->dims = gdims;
     }
-    args->output_lists[0][i] = b;
+    staged.push_back(std::move(b));
+  }
+  for (size_t i = 0; i < staged.size(); i++) {
+    args->output_lists[0][i] = staged[i].release();
   }
   if (args->device_complete_events != nullptr) {
     args->device_complete_events[0] = nullptr;  // ExecuteSharded blocked

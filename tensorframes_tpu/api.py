@@ -517,6 +517,15 @@ def map_blocks(
             )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
+            if bindings:
+                # All fetches were string pass-throughs, so no compute
+                # graph runs and no placeholder can consume a binding —
+                # a typo'd key must not be dropped on the floor.
+                raise ValueError(
+                    "map_blocks: bindings "
+                    f"{sorted(bindings)} match no placeholder (the "
+                    "graph is pure string pass-through)"
+                )
             dev_cols = []
         return _output_frame(frame, dev_cols + str_cols, append_input=True)
     if mesh is not None:
@@ -777,6 +786,14 @@ def map_rows(
             )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
+            if bindings:
+                # Mirror the map_blocks check: pure string pass-through
+                # runs no compute graph, so every binding key is a typo.
+                raise ValueError(
+                    "map_rows: bindings "
+                    f"{sorted(bindings)} match no placeholder (the "
+                    "graph is pure string pass-through)"
+                )
             dev_cols = []
         return _output_frame(frame, dev_cols + str_cols, append_input=True)
     overrides = _ph_overrides(

@@ -257,8 +257,16 @@ def aggregate_global(
         Pad rows decode to "" but are sliced off by the true lengths."""
         arr = np.asarray(arr)
         if arr.dtype == object or arr.dtype.kind in ("U", "S"):
-            sarr = np.array([str(x) for x in arr], dtype="<U1") if arr.size == 0 \
-                else np.array([str(x) for x in arr])
+            # bytes cells (numpy 'S' kind, Arrow binary) must DECODE,
+            # not stringify: str(b"abc") is the repr "b'abc'", which
+            # would silently corrupt group keys across processes.
+            def _cell(x):
+                if isinstance(x, bytes):
+                    return x.decode("utf-8", "surrogateescape")
+                return str(x)
+
+            sarr = np.array([_cell(x) for x in arr], dtype="<U1") \
+                if arr.size == 0 else np.array([_cell(x) for x in arr])
             w = max(1, sarr.dtype.itemsize // 4)
             wmax = int(
                 np.asarray(

@@ -121,6 +121,35 @@ WORKER = textwrap.dedent(
             assert abs(got[k] - want) < 1e-9, (k, got, want)
         print(f"proc {pid} OK agg-strings", flush=True)
 
+    elif scenario == "aggregate-bytes":
+        # bytes key columns (numpy 'S' kind, what Arrow binary columns
+        # decay to) must DECODE before the UCS4 ride — str(b"alpha")
+        # would corrupt every key into the repr "b'alpha'"
+        names = np.array([b"alpha", b"b", b"gamma"], dtype="S5")
+        keys = names[(np.arange(4) + pid) % 3]
+        local_kv = tfs.TensorFrame.from_dict(
+            {"k": keys.astype(object), "x": np.arange(4.0) + 4 * pid}
+        )
+        x_input = tfs.block(local_kv, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        out = mh.aggregate_global(s, tfs.group_by(local_kv, "k"))
+        got_keys = {str(v) for v in out["k"].host_values()}
+        assert not any(k.startswith("b'") for k in got_keys), got_keys
+        got = dict(
+            zip(
+                [str(v) for v in out["k"].host_values()],
+                out["x"].values.tolist(),
+            )
+        )
+        all_k = np.concatenate(
+            [names[(np.arange(4) + p) % 3] for p in range(nprocs)]
+        )
+        all_x = np.arange(4.0 * nprocs)
+        for k in np.unique([v.decode() for v in all_k]):
+            want = all_x[[v.decode() == k for v in all_k]].sum()
+            assert abs(got[k] - want) < 1e-9, (k, got, want)
+        print(f"proc {pid} OK agg-bytes", flush=True)
+
     elif scenario == "analyze":
         # ragged vectors whose lengths agree within a host but differ
         # across hosts -> merged cell shape must widen to unknown
@@ -213,6 +242,10 @@ def test_global_aggregate(tmp_path, nprocs):
 @pytest.mark.parametrize("nprocs", [2, 4])
 def test_global_aggregate_string_keys(tmp_path, nprocs):
     _run_workers(tmp_path, nprocs, "aggregate-strings")
+
+
+def test_global_aggregate_bytes_keys(tmp_path):
+    _run_workers(tmp_path, 2, "aggregate-bytes")
 
 
 def test_distributed_analyze(tmp_path):

@@ -1009,6 +1009,19 @@ class TestBytesCells:
         out = tfs.map_rows(dsl.identity(tag).named("t"), df)
         assert list(out["t"].rows()) == [b"a", b"bb", b"ccc"]
 
+    def test_passthrough_only_rejects_unknown_bindings(self):
+        # pure string pass-through runs no compute graph: a typo'd
+        # binding key must raise, not be silently dropped (round-4
+        # advisor finding)
+        df = self._frame()
+        tag = dsl.placeholder(ScalarType.string, Shape(()), name="tag")
+        for verb in (tfs.map_rows, tfs.map_blocks):
+            with pytest.raises(ValueError, match="typo"):
+                verb(
+                    dsl.identity(tag).named("t"), df,
+                    bindings={"typo": np.float32(5.0)},
+                )
+
     def test_compute_on_bytes_rejected(self):
         from tensorframes_tpu.graph.ir import Graph, GraphNode
         from tensorframes_tpu.proto.graphdef import AttrValue

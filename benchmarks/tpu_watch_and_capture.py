@@ -29,10 +29,10 @@ from __future__ import annotations
 
 import datetime
 import os
-import signal
 import subprocess
 import sys
 import time
+from typing import Optional
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -57,31 +57,40 @@ def _log(msg: str) -> None:
 
 
 def _wait_or_terminate(proc: subprocess.Popen, timeout_s: float):
-    """Wait up to ``timeout_s``; on timeout SIGTERM and grace-wait 20s.
-    Returns the return code, or None if the child had to be terminated."""
-    try:
-        return proc.wait(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=20)
-        except subprocess.TimeoutExpired:
-            # Last resort only AFTER the grace period: a SIGTERM-deaf
-            # child blocked in the driver would otherwise pin the PTY.
-            proc.kill()
-            proc.wait()
-        return None
+    """SIGTERM-with-grace, NEVER SIGKILL: force-killing a child mid
+    device-claim is what leaks grants and wedges the shared chip (the
+    same rule as bench.py). A SIGTERM-deaf child is left running; the
+    caller must not stack another probe on top of it."""
+    sys.path.insert(0, ROOT)
+    from tensorframes_tpu.runtime.pjrt_host import wait_or_terminate
+
+    return wait_or_terminate(proc, timeout_s)
+
+
+# A probe child that ignored SIGTERM (blocked in the driver mid-claim).
+# While it lives, the watcher must NOT spawn further probes: each would
+# be another claimant queued on the wedged grant.
+_lingering: Optional[subprocess.Popen] = None
 
 
 def _probe(timeout_s: float):
+    global _lingering
     import tempfile
 
+    if _lingering is not None:
+        if _lingering.poll() is None:
+            return "lingering", f"pid {_lingering.pid} still in SIGTERM grace"
+        _log(f"lingering probe pid {_lingering.pid} exited "
+             f"rc={_lingering.returncode}")
+        _lingering = None
     with tempfile.TemporaryFile(mode="w+") as errf, \
             tempfile.TemporaryFile(mode="w+") as outf:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_CHILD], stdout=outf, stderr=errf,
         )
         rc = _wait_or_terminate(proc, timeout_s)
+        if rc is None and proc.poll() is None:
+            _lingering = proc
         errf.seek(0)
         outf.seek(0)
         platform = outf.read().strip()

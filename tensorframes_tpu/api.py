@@ -730,8 +730,15 @@ def _run_ragged_bucketed(
             outs = dict(zip(out_names_hint, outs))
         idx_arr = np.asarray(idxs)
         for name, o in outs.items():
-            chunks.setdefault(name, []).append((idx_arr, np.asarray(o)[:nb]))
+            # keep the DEVICE array (slicing is lazy): converting here
+            # would block on transfer before the next bucket dispatches,
+            # serializing the whole plan — with per-shard device
+            # placement (parallel.verbs._ragged_per_shard) every
+            # device's buckets must be in flight before any fetch
+            chunks.setdefault(name, []).append((idx_arr, o[:nb]))
 
+    # device->host conversion happens HERE, after every bucket (and, for
+    # the mesh path, every shard's device) has been dispatched
     per_row: Dict[str, Union[np.ndarray, List[np.ndarray]]] = {}
     for name, pairs in chunks.items():
         cell_shapes = {o.shape[1:] for _, o in pairs}
@@ -739,11 +746,12 @@ def _run_ragged_bucketed(
             shape = next(iter(cell_shapes))
             res = np.empty((nrows,) + shape, dtype=pairs[0][1].dtype)
             for idx_arr, o in pairs:
-                res[idx_arr] = o
+                res[idx_arr] = np.asarray(o)
             per_row[name] = res
         else:
             rows: List[Optional[np.ndarray]] = [None] * nrows
             for idx_arr, o in pairs:
+                o = np.asarray(o)
                 for j, i in enumerate(idx_arr):
                     rows[i] = o[j]
             per_row[name] = rows
@@ -757,6 +765,7 @@ def map_rows(
     feed_dict: Optional[Dict[str, str]] = None,
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
+    mesh=None,
     bindings: Optional[Dict[str, "np.ndarray"]] = None,
 ) -> TensorFrame:
     """Apply a graph independently to every row.
@@ -767,22 +776,33 @@ def map_rows(
     per row (`performMapRows`, `DebugRowOps.scala:826-864`). Ragged columns
     fall back to a per-row loop (compile-cached per distinct cell shape),
     the moral equivalent of the reference's variable-length row support
-    (`TFDataOps.scala:90-103`). ``bindings`` holds per-call bound
-    placeholders constant across all rows (vmap in_axes=None), the same
-    jit-argument semantics as map_blocks bindings.
+    (`TFDataOps.scala:90-103`). With ``mesh=`` rows shard across the
+    device mesh (see `parallel.verbs.map_rows`). ``bindings`` holds
+    per-call bound placeholders constant across all rows (vmap
+    in_axes=None), the same jit-argument semantics as map_blocks
+    bindings.
     """
     ex = executor or default_executor()
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
+        if mesh is not None:
+            from .parallel import verbs as _pverbs
+
+            return _pverbs.map_rows(
+                fetches, frame, mesh, feed_dict, fetch_names, executor,
+                bindings=bindings,
+            )
         return _map_rows_fn(fetches, frame, bindings=bindings)
     graph, fetch_list = _as_graph(fetches, fetch_names)
     graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
     if str_pass:
+        # bytes columns ride host-side in every topology: split them off
+        # BEFORE the mesh dispatch so mesh= behaves like the local path
         str_cols = _string_passthrough_columns(str_pass, frame, feed_dict)
         if fetch_list:
             dev = map_rows(
                 graph, frame, feed_dict, fetch_list, executor,
-                bindings=bindings,
+                mesh=mesh, bindings=bindings,
             )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
@@ -796,6 +816,13 @@ def map_rows(
                 )
             dev_cols = []
         return _output_frame(frame, dev_cols + str_cols, append_input=True)
+    if mesh is not None:
+        from .parallel import verbs as _pverbs
+
+        return _pverbs.map_rows(
+            graph, frame, mesh, feed_dict, fetch_list, executor,
+            bindings=bindings,
+        )
     overrides = _ph_overrides(
         graph, frame, feed_dict, block_level=False, bindings=bindings
     )

@@ -55,6 +55,146 @@ class TestDistributedMapBlocks:
         np.testing.assert_allclose(out["c"].values, expect)
 
 
+class TestDistributedMapRows:
+    """Mesh map_rows mirrors TestDistributedMapBlocks: rows shard across
+    the data axis (`DebugRowOps.scala:403-484` ran mapRows over every
+    partition like the other verbs)."""
+
+    def test_elementwise(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        out = tfs.map_rows((x * 2.0 + 1.0).named("y"), df, mesh=mesh)
+        np.testing.assert_array_equal(
+            out["y"].values, np.arange(16.0) * 2.0 + 1.0
+        )
+        assert out.columns == ["y", "x"]
+
+    def test_remainder_tail(self, mesh):
+        # 19 rows over 8 devices: 16 via shard_map(vmap) + 3-row tail.
+        df = tfs.TensorFrame.from_dict({"x": np.arange(19.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        out = tfs.map_rows((x * x).named("y"), df, mesh=mesh)
+        np.testing.assert_array_equal(out["y"].values, np.arange(19.0) ** 2)
+
+    def test_vector_cells(self, mesh):
+        df = tfs.TensorFrame.from_dict({"v": np.arange(32.0).reshape(16, 2)})
+        v = dsl.placeholder(ScalarType.float64, Shape((2,)), name="v")
+        s = dsl.reduce_sum(v, axes=[0]).named("s")
+        out = tfs.map_rows(s, df, mesh=mesh)
+        np.testing.assert_array_equal(
+            out["s"].values, df["v"].values.sum(axis=1)
+        )
+
+    def test_multi_fetch_ordering(self, mesh):
+        # two fetches whose VALUES would collide if routing swapped them
+        df = tfs.TensorFrame.from_dict({"x": np.arange(10.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        a = (x + 1.0).named("a")
+        b = (x - 1.0).named("b")
+        out = tfs.map_rows([b, a], df, mesh=mesh)
+        np.testing.assert_array_equal(out["a"].values, np.arange(10.0) + 1.0)
+        np.testing.assert_array_equal(out["b"].values, np.arange(10.0) - 1.0)
+
+    def test_bindings_replicated(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(19.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        c = dsl.placeholder(ScalarType.float64, Shape(()), name="c")
+        out = tfs.map_rows(
+            (x * c).named("y"), df, mesh=mesh, bindings={"c": np.float64(3.0)}
+        )
+        np.testing.assert_array_equal(out["y"].values, np.arange(19.0) * 3.0)
+
+    def test_matches_local_verb(self, mesh):
+        # mesh= and the local path must agree bit-for-bit
+        df = tfs.TensorFrame.from_dict({"x": np.arange(13.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        y = dsl.tanh(x * 0.5).named("y")
+        local = tfs.map_rows(y, df)
+        meshed = tfs.map_rows(y, df, mesh=mesh)
+        np.testing.assert_array_equal(local["y"].values, meshed["y"].values)
+
+    def test_ragged_per_shard(self, mesh):
+        cells = [np.arange(1 + (i % 3), dtype=np.float32) for i in range(21)]
+        df = tfs.TensorFrame.from_dict({"v": cells})
+        v = dsl.placeholder(ScalarType.float32, Shape((None,)), name="v")
+        s = dsl.reduce_sum(v, axes=[0]).named("s")
+        out = tfs.map_rows(s, df, mesh=mesh)
+        np.testing.assert_allclose(
+            out["s"].values, [c.sum() for c in cells]
+        )
+
+    def test_fn_front_end(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(10.0)})
+        out = tfs.map_rows(lambda x: {"sq": x * x}, df, mesh=mesh)
+        np.testing.assert_array_equal(out["sq"].values, np.arange(10.0) ** 2)
+
+    def test_small_frame_fewer_rows_than_devices(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(3.0)})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        out = tfs.map_rows((x + 1.0).named("y"), df, mesh=mesh)
+        np.testing.assert_array_equal(out["y"].values, np.arange(3.0) + 1.0)
+
+    def test_empty_frame(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.zeros((0,))})
+        x = dsl.placeholder(ScalarType.float64, Shape(()), name="x")
+        out = tfs.map_rows((x + 1.0).named("y"), df, mesh=mesh)
+        assert out["y"].values.shape[0] == 0
+
+
+class TestMeshFnFrontEnd:
+    """map_blocks mesh= with the function front-end (previously raised
+    TypeError despite the api-level dispatch)."""
+
+    def test_map_blocks_fn(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        out = tfs.map_blocks(lambda x: {"x2": x * 2.0}, df, mesh=mesh)
+        np.testing.assert_array_equal(out["x2"].values, np.arange(16.0) * 2)
+
+    def test_map_blocks_fn_trim(self, mesh):
+        # per-shard reduction: each device's block sums independently
+        df = tfs.TensorFrame.from_dict({"x": np.arange(16.0)})
+        out = tfs.map_blocks(
+            lambda x: {"s": x.sum(keepdims=True)}, df, mesh=mesh, trim=True
+        )
+        np.testing.assert_array_equal(
+            np.sort(out["s"].values),
+            np.sort(np.arange(16.0).reshape(8, 2).sum(1)),
+        )
+
+    def test_map_blocks_fn_tail_and_bindings(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(19.0)})
+        out = tfs.map_blocks(
+            lambda x, c: {"y": x * c},
+            df, mesh=mesh, bindings={"c": np.float64(4.0)},
+        )
+        np.testing.assert_array_equal(out["y"].values, np.arange(19.0) * 4.0)
+
+    def test_fn_mesh_programs_cached(self, mesh):
+        # a NAMED fn reused across calls must reuse its compiled
+        # shard/tail programs (fresh-lambda callers recompile, same as
+        # jax.jit's own identity cache)
+        from tensorframes_tpu.parallel import verbs as pv
+
+        df = tfs.TensorFrame.from_dict({"x": np.arange(19.0)})
+
+        def double(x):
+            return {"y": x * 2.0}
+
+        tfs.map_blocks(double, df, mesh=mesh)
+        n = len(pv._FN_MESH_CACHE)
+        out = tfs.map_blocks(double, df, mesh=mesh)
+        assert len(pv._FN_MESH_CACHE) == n
+        np.testing.assert_array_equal(out["y"].values, np.arange(19.0) * 2)
+
+    def test_map_blocks_fn_unknown_binding_raises(self, mesh):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        with pytest.raises(ValueError, match="typo"):
+            tfs.map_blocks(
+                lambda x: {"y": x}, df, mesh=mesh,
+                bindings={"typo": np.float64(1.0)},
+            )
+
+
 class TestDistributedReduceBlocks:
     def test_sum_over_ici(self, mesh):
         df = tfs.TensorFrame.from_dict({"x": np.arange(100.0)})

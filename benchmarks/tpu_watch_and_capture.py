@@ -35,6 +35,8 @@ import time
 from typing import Optional
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
 
 # Staged markers so a hang's log line names the exact stage that wedged.
 _PROBE_CHILD = """
@@ -61,7 +63,6 @@ def _wait_or_terminate(proc: subprocess.Popen, timeout_s: float):
     device-claim is what leaks grants and wedges the shared chip (the
     same rule as bench.py). A SIGTERM-deaf child is left running; the
     caller must not stack another probe on top of it."""
-    sys.path.insert(0, ROOT)
     from tensorframes_tpu.runtime.pjrt_host import wait_or_terminate
 
     return wait_or_terminate(proc, timeout_s)

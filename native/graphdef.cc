@@ -233,7 +233,11 @@ int tfs_graph_validate(void* h, char* err, size_t errlen) {
       return 1;
     }
   }
-  // Kahn's algorithm over base edges.
+  // Kahn's algorithm over base edges. Edges produced by NextIteration
+  // are TF's one legal back edge (v1 while loops cycle through
+  // NextIteration -> Merge); they are excluded from the ordering so a
+  // well-formed loop graph validates, and the Python functionalization
+  // pass (graph/control_flow.py) removes them before lowering.
   std::vector<std::vector<int32_t>> consumers(g->nodes.size());
   std::vector<int32_t> indegree(g->nodes.size(), 0);
   for (size_t i = 0; i < g->nodes.size(); i++) {
@@ -244,6 +248,9 @@ int tfs_graph_validate(void* h, char* err, size_t errlen) {
                  g->nodes[i].name.c_str(), edge_base(e).c_str());
         return 1;
       }
+      const std::string& producer_op = g->nodes[it->second].op;
+      if (producer_op == "NextIteration" || producer_op == "RefNextIteration")
+        continue;
       consumers[it->second].push_back(static_cast<int32_t>(i));
       indegree[i]++;
     }

@@ -123,6 +123,13 @@ def _as_graph(
             "imported graphs need explicit fetch_names=[...] "
             "(the reference's builder.fetches, PythonInterface.scala:105-108)"
         )
+    # Control flow (v1 Switch/Merge rings, v2 If/While, function calls)
+    # functionalizes to _Cond/_While pseudo-nodes FIRST — the reference
+    # could hand any GraphDef to libtensorflow (`TensorFlowOps.scala:76-95`);
+    # here the same graphs must become lax.cond/lax.while_loop to compile.
+    from .graph.control_flow import functionalize
+
+    g, fetch_names = functionalize(g, list(fetch_names))
     # Stateful graphs are frozen at import, exactly where the reference
     # freezes them (`_get_graph` -> `_initialize_variables`, core.py:42-56).
     from .graph.freeze import freeze_variables
@@ -690,6 +697,7 @@ def _run_ragged_bucketed(
     columns: List[Column],
     nrows: int,
     out_names_hint: Optional[List[str]] = None,
+    defer: bool = False,
 ) -> Dict[str, List[np.ndarray]]:
     """Shape-bucketed execution for ragged rows: group rows by their joint
     cell-shape signature, run ONE vmapped XLA call per bucket, scatter the
@@ -705,6 +713,13 @@ def _run_ragged_bucketed(
     ``vfn`` is a vmapped callable returning either a tuple (graph path,
     ``out_names_hint`` gives the names) or a dict (function front-end).
     Returns name -> list of per-row output cells (row order).
+
+    ``defer=True`` returns the raw chunk pairs (name -> [(row indices,
+    DEVICE array)]) without assembling: the mesh ragged path
+    (`parallel.verbs._ragged_per_shard`) runs this once per device and
+    must not block on device-to-host transfer between shards — it
+    collects every shard's chunks and assembles once at the end via
+    `_assemble_ragged`.
     """
     cells = [c.values if c.is_dense else c.ragged for c in columns]
     buckets: Dict[Tuple, List[int]] = {}
@@ -737,8 +752,17 @@ def _run_ragged_bucketed(
             # device's buckets must be in flight before any fetch
             chunks.setdefault(name, []).append((idx_arr, o[:nb]))
 
-    # device->host conversion happens HERE, after every bucket (and, for
-    # the mesh path, every shard's device) has been dispatched
+    if defer:
+        return chunks
+    return _assemble_ragged(chunks, nrows)
+
+
+def _assemble_ragged(
+    chunks: Dict[str, List[Tuple[np.ndarray, "jax.Array"]]], nrows: int
+) -> Dict[str, Union[np.ndarray, List[np.ndarray]]]:
+    """Scatter bucketed chunk outputs back into row order. Device->host
+    conversion happens HERE, after every bucket (and, for the mesh path,
+    every shard's device) has been dispatched."""
     per_row: Dict[str, Union[np.ndarray, List[np.ndarray]]] = {}
     for name, pairs in chunks.items():
         cell_shapes = {o.shape[1:] for _, o in pairs}

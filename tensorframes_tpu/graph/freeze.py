@@ -215,4 +215,8 @@ def freeze_variables(graph: Graph) -> Graph:
                 continue  # ordering edge into pruned init machinery
             kept_inputs.append(e)
         out.add(GraphNode(n.name, n.op, kept_inputs, dict(n.attrs)))
+    # control-flow side tables survive freezing
+    out.library = graph.library
+    out._library_proto = graph._library_proto
+    out.subgraphs = dict(graph.subgraphs)
     return out

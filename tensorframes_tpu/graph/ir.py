@@ -20,10 +20,21 @@ import hashlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from ..proto.graphdef import AttrValue, GraphDef, NodeDef
+from ..proto.graphdef import AttrValue, FunctionDef, GraphDef, NodeDef
 from ..schema import ScalarType, Shape
 
-__all__ = ["GraphNode", "Graph", "parse_edge"]
+__all__ = ["GraphNode", "Graph", "Subgraph", "parse_edge"]
+
+
+@dataclass
+class Subgraph:
+    """An extracted control-flow body: a Graph plus its feed (placeholder
+    name) order and fetch edges. `_Cond`/`_While` lowering rules build a
+    callable from this exactly like a top-level graph."""
+
+    graph: "Graph"
+    feeds: List[str]
+    fetches: List[str]
 
 
 def parse_edge(edge: str) -> Tuple[str, int, bool]:
@@ -81,12 +92,25 @@ class GraphNode:
 
 
 class Graph:
-    """An ordered, named DAG of `GraphNode`s."""
+    """An ordered, named DAG of `GraphNode`s.
+
+    Two side tables ride along for control flow:
+
+    - ``library``: FunctionDefs from the GraphDef's FunctionDefLibrary
+      (name -> FunctionDef), consumed by `graph.control_flow` to inline
+      `PartitionedCall` sites and lower `If`/`While` branches.
+    - ``subgraphs``: extracted loop/branch bodies (key -> Subgraph),
+      referenced by name from `_Cond`/`_While` pseudo-node attrs after
+      functionalization. Keys embed a content hash, so the main graph's
+      byte fingerprint still distinguishes different bodies.
+    """
 
     def __init__(self, nodes: Optional[List[GraphNode]] = None):
         self.nodes: List[GraphNode] = []
         self._by_name: Dict[str, GraphNode] = {}
         self._fingerprint: Optional[str] = None
+        self.library: Dict[str, "FunctionDef"] = {}
+        self.subgraphs: Dict[str, "Subgraph"] = {}
         for n in nodes or []:
             self.add(n)
 
@@ -156,11 +180,19 @@ class Graph:
 
     # -- GraphDef interchange -------------------------------------------
     def to_graph_def(self) -> GraphDef:
-        return GraphDef([n.to_node_def() for n in self.nodes])
+        gd = GraphDef([n.to_node_def() for n in self.nodes])
+        gd.library = self._library_proto
+        return gd
+
+    _library_proto = None  # raw FunctionDefLibrary for re-serialization
 
     @classmethod
     def from_graph_def(cls, gd: GraphDef) -> "Graph":
-        return cls([GraphNode.from_node_def(n) for n in gd.nodes])
+        g = cls([GraphNode.from_node_def(n) for n in gd.nodes])
+        if gd.library is not None:
+            g.library = gd.library.by_name()
+            g._library_proto = gd.library
+        return g
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "Graph":
@@ -182,6 +214,17 @@ class Graph:
                     k: AttrValue.from_bytes(v) for k, v in raw_attrs.items()
                 }
                 g.add(GraphNode(name, op, inputs, attrs))
+            # the native parser returns nodes only: scan field 2 (the
+            # FunctionDefLibrary) with the Python codec so If/While
+            # branches and PartitionedCall bodies are not dropped
+            from ..proto import wire
+            from ..proto.graphdef import FunctionDefLibrary
+
+            for f, _, v in wire.iter_fields(data):
+                if f == 2:
+                    lib = FunctionDefLibrary.from_bytes(v)
+                    g.library = lib.by_name()
+                    g._library_proto = lib
             return g
         return cls.from_graph_def(GraphDef.from_bytes(data))
 

@@ -1,0 +1,70 @@
+"""Lowering rules for functionalized control flow.
+
+`graph.control_flow` rewrites imported TF control flow (v1
+Switch/Merge/Enter/Exit rings and v2 functional If/While) into `_Cond`
+and `_While` pseudo-nodes whose bodies live in the graph's `subgraphs`
+side table. These rules lower them to `lax.cond` / `lax.while_loop` —
+the compiler-friendly forms XLA requires (SURVEY.md L8: libtensorflow
+ran any GraphDef interpretively; here control flow compiles).
+
+Both rules build the body callables with `build_callable` on the
+extracted `Subgraph`s, so nested control flow, function calls, and the
+whole op registry work inside bodies for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import GraphLoweringError, register
+
+
+def _sub(ctx, node, attr_key):
+    key = node.attr(attr_key)
+    key = key.decode() if isinstance(key, bytes) else key
+    graph = getattr(ctx, "graph", None)
+    if graph is None or key not in getattr(graph, "subgraphs", {}):
+        raise GraphLoweringError(
+            f"node {node.name!r} references missing subgraph {key!r} — "
+            "was the graph functionalized by graph.control_flow?"
+        )
+    return graph.subgraphs[key]
+
+
+@register("_Cond")
+def _cond(ctx, node, inputs):
+    from .lowering import build_callable
+
+    tsub = _sub(ctx, node, "cond_then")
+    esub = _sub(ctx, node, "cond_else")
+    tfn = build_callable(tsub.graph, tsub.fetches, tsub.feeds)
+    efn = build_callable(esub.graph, esub.fetches, esub.feeds)
+    pred, *operands = inputs
+    pred = jnp.reshape(jnp.asarray(pred).astype(bool), ())
+    out = lax.cond(
+        pred,
+        lambda ops: tuple(tfn(*ops)),
+        lambda ops: tuple(efn(*ops)),
+        tuple(jnp.asarray(v) for v in operands),
+    )
+    return tuple(out)
+
+
+@register("_While")
+def _while(ctx, node, inputs):
+    from .lowering import build_callable
+
+    csub = _sub(ctx, node, "while_cond")
+    bsub = _sub(ctx, node, "while_body")
+    n_vars = int(node.attr("n_vars"))
+    cond_fn = build_callable(csub.graph, csub.fetches, csub.feeds)
+    body_fn = build_callable(bsub.graph, bsub.fetches, bsub.feeds)
+    carry = tuple(jnp.asarray(v) for v in inputs)
+    out = lax.while_loop(
+        lambda c: jnp.reshape(cond_fn(*c)[0], ()).astype(bool),
+        lambda c: tuple(body_fn(*c)),
+        carry,
+    )
+    # invariant captures ride the carry but are not node outputs
+    return tuple(out[:n_vars])

@@ -17,6 +17,7 @@ import numpy as np
 from ..graph.ir import Graph, parse_edge
 from .registry import GraphLoweringError, LowerCtx, get_rule
 from . import standard  # noqa: F401  (populates the registry)
+from . import control  # noqa: F401  (_Cond/_While rules)
 
 __all__ = ["build_callable", "supported", "GraphLoweringError"]
 
@@ -42,6 +43,8 @@ def build_callable(
     order = graph.toposort(list(fetches))
     feed_pos = {name: i for i, name in enumerate(feed_names)}
     ctx = LowerCtx()
+    # _Cond/_While rules resolve their body Subgraphs through the ctx
+    ctx.graph = graph
 
     for node in order:
         if node.op in ("Placeholder", "PlaceholderV2"):

@@ -254,7 +254,11 @@ def _ragged_per_shard(
     """
     devices = list(mesh.devices.flat)
     bounds = np.linspace(0, nrows, len(devices) + 1).astype(int)
-    shard_outs = []
+    # deferred chunks from EVERY shard are collected before any
+    # device->host fetch: the Python loop issues all shards' buckets
+    # (async dispatch onto their devices) and _assemble_ragged blocks
+    # only once, at the end
+    all_chunks: Dict[str, List] = {}
     for d, dev in enumerate(devices):
         lo, hi = int(bounds[d]), int(bounds[d + 1])
         if lo == hi:
@@ -271,26 +275,15 @@ def _ragged_per_shard(
             )
             for c in columns
         ]
-        shard_outs.append(
-            _api._run_ragged_bucketed(
-                dev_vfn, shard_cols, hi - lo, out_names_hint=out_names_hint
+        chunks = _api._run_ragged_bucketed(
+            dev_vfn, shard_cols, hi - lo,
+            out_names_hint=out_names_hint, defer=True,
+        )
+        for name, pairs in chunks.items():
+            all_chunks.setdefault(name, []).extend(
+                (idx + lo, o) for idx, o in pairs
             )
-        )
-    per_row = {}
-    names = sorted({n for p in shard_outs for n in p})
-    for name in names:
-        segs = [p[name] for p in shard_outs]
-        dense = all(isinstance(s, np.ndarray) for s in segs) and (
-            len({s.shape[1:] for s in segs}) == 1
-        )
-        if dense:
-            per_row[name] = np.concatenate(segs)
-        else:
-            cells: List[np.ndarray] = []
-            for s in segs:
-                cells.extend(np.asarray(c) for c in s)
-            per_row[name] = cells
-    return per_row
+    return _api._assemble_ragged(all_chunks, nrows)
 
 
 def map_rows(

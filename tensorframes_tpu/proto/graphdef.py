@@ -294,6 +294,31 @@ class AttrListValue:
         return bytes(out)
 
 
+@dataclass
+class NameAttrList:
+    """A function reference in an attr (`func` one-of, AttrValue field
+    10): name + instantiation attrs. Carried raw-bytes-stable so nodes
+    holding func attrs (If/While/PartitionedCall) round-trip exactly."""
+
+    name: str
+    raw: bytes = b""
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "NameAttrList":
+        name = ""
+        for f, _, v in wire.iter_fields(data):
+            if f == 1:
+                name = v.decode("utf-8")
+        return cls(name, data)
+
+    def to_bytes(self) -> bytes:
+        if self.raw:
+            return self.raw
+        out = bytearray()
+        wire.write_string_field(out, 1, self.name)
+        return bytes(out)
+
+
 AttrPayload = Union[
     bytes, int, float, bool, ScalarType, Shape, None, TensorProto, AttrListValue, str
 ]
@@ -331,6 +356,8 @@ class AttrValue:
                 kind, value = "tensor", TensorProto.from_bytes(v)
             elif f == 9:
                 kind, value = "placeholder", v.decode("utf-8")
+            elif f == 10:  # NameAttrList: a function reference (If/While)
+                kind, value = "func", NameAttrList.from_bytes(v)
         return cls(kind, value)
 
     def to_bytes(self) -> bytes:
@@ -354,6 +381,8 @@ class AttrValue:
             wire.write_len_field(out, 8, v.to_bytes())
         elif k == "placeholder":
             wire.write_string_field(out, 9, v)
+        elif k == "func":
+            wire.write_len_field(out, 10, v.to_bytes())
         return bytes(out)
 
     # convenience constructors
@@ -441,22 +470,109 @@ class NodeDef:
 
 
 @dataclass
+class ArgDef:
+    """One input/output arg of a function signature (OpDef.ArgDef)."""
+
+    name: str = ""
+    type: Optional[ScalarType] = None
+    type_attr: str = ""
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ArgDef":
+        name, typ, type_attr = "", None, ""
+        for f, _, v in wire.iter_fields(data):
+            if f == 1:
+                name = v.decode("utf-8")
+            elif f == 3:
+                try:
+                    typ = ScalarType.from_tf_datatype(v)
+                except UnsupportedTypeError:
+                    typ = None
+            elif f == 4:
+                type_attr = v.decode("utf-8")
+        return cls(name, typ, type_attr)
+
+
+@dataclass
+class FunctionDef:
+    """A library function: signature args, body nodes, and the ret map
+    (output arg name -> body edge in `node:out_arg:index` syntax).
+    Parsed for `If`/`While` branch lowering and `PartitionedCall`
+    inlining (`graph/control_flow.py`); the raw bytes are kept so the
+    enclosing library re-serializes byte-stably."""
+
+    name: str = ""
+    input_args: List[ArgDef] = field(default_factory=list)
+    output_args: List[ArgDef] = field(default_factory=list)
+    nodes: List[NodeDef] = field(default_factory=list)
+    ret: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FunctionDef":
+        fd = cls()
+        for f, _, v in wire.iter_fields(data):
+            if f == 1:  # OpDef signature
+                for f2, _, v2 in wire.iter_fields(v):
+                    if f2 == 1:
+                        fd.name = v2.decode("utf-8")
+                    elif f2 == 2:
+                        fd.input_args.append(ArgDef.from_bytes(v2))
+                    elif f2 == 3:
+                        fd.output_args.append(ArgDef.from_bytes(v2))
+            elif f == 3:
+                fd.nodes.append(NodeDef.from_bytes(v))
+            elif f == 4:  # map<string,string> ret entry
+                k = rv = ""
+                for f2, _, v2 in wire.iter_fields(v):
+                    if f2 == 1:
+                        k = v2.decode("utf-8")
+                    elif f2 == 2:
+                        rv = v2.decode("utf-8")
+                fd.ret[k] = rv
+        return fd
+
+
+@dataclass
+class FunctionDefLibrary:
+    functions: List[FunctionDef] = field(default_factory=list)
+    raw: bytes = b""  # byte-stable re-serialization
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FunctionDefLibrary":
+        fns = []
+        for f, _, v in wire.iter_fields(data):
+            if f == 1:
+                fns.append(FunctionDef.from_bytes(v))
+        return cls(fns, data)
+
+    def to_bytes(self) -> bytes:
+        return self.raw
+
+    def by_name(self) -> Dict[str, FunctionDef]:
+        return {f.name: f for f in self.functions}
+
+
+@dataclass
 class GraphDef:
     nodes: List[NodeDef] = field(default_factory=list)
     producer: int = 26  # TF 1.6-era graph version, matching the reference
+    library: Optional[FunctionDefLibrary] = None
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "GraphDef":
         nodes: List[NodeDef] = []
         producer = 0
+        library = None
         for f, _, v in wire.iter_fields(data):
             if f == 1:
                 nodes.append(NodeDef.from_bytes(v))
+            elif f == 2:  # FunctionDefLibrary
+                library = FunctionDefLibrary.from_bytes(v)
             elif f == 4:  # VersionDef
                 for f2, _, v2 in wire.iter_fields(v):
                     if f2 == 1:
                         producer = v2
-        return cls(nodes, producer)
+        return cls(nodes, producer, library)
 
     @classmethod
     def from_file(cls, path: str) -> "GraphDef":
@@ -467,6 +583,8 @@ class GraphDef:
         out = bytearray()
         for n in self.nodes:
             wire.write_len_field(out, 1, n.to_bytes())
+        if self.library is not None and self.library.to_bytes():
+            wire.write_len_field(out, 2, self.library.to_bytes())
         versions = bytearray()
         wire.write_varint_field(versions, 1, self.producer)
         wire.write_len_field(out, 4, bytes(versions))

@@ -1,0 +1,443 @@
+"""Keyed-aggregation planner: the three execution plans behind
+`api.aggregate`.
+
+Extracted from `api.py` (round-4 verdict task 7: the three plans alone
+were a module's worth). The public verb surface — `aggregate()`,
+`GroupedFrame`, `group_by` — stays in `api.py`; this module holds the
+planning/execution machinery:
+
+- `_aggregate_segment`: device segment ops over factorized keys (with
+  the one-hot MXU lowering for small key counts on TPU);
+- the exact per-size vmap plan (`_group_plan` + batched groups);
+- `_aggregate_chunked`: pow2-chunk partials + derived-monoid combine
+  (`_chunk_combiners` classifies which graphs are chunk-safe).
+
+`parallel/verbs.py` and `parallel/multihost.py` reuse the same planner
+pieces for the mesh and DCN paths; `api.py` re-exports every name so
+existing `api._chunk_combiners`-style references keep resolving.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .frame import Column, TensorFrame, factorize_keys
+from .graph.analysis import GraphSummary
+from .graph.ir import Graph, base_name as _base
+from .ops.lowering import build_callable
+from .runtime.retry import maybe_check_numerics
+
+
+def _group_plan(
+    grouped: GroupedFrame,
+    mapping: Dict[str, str],
+    feed_names: List[str],
+):
+    """Shared keyed-aggregation prologue: factorize keys, sort rows by
+    group, gather sorted feed columns. Returns
+    ``(key_out, num_groups, counts, starts, col_data)`` — the one copy of
+    the Catalyst-shuffle analogue both the host and mesh paths use."""
+    frame = grouped.frame
+    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
+    num_groups = len(next(iter(key_out.values())))
+    order = np.argsort(inverse, kind="stable")
+    counts = np.bincount(inverse, minlength=num_groups)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
+    return key_out, num_groups, counts, starts, col_data
+
+
+def _keyed_output(
+    key_out: Dict[str, np.ndarray],
+    results: Dict[str, np.ndarray],
+    bases: List[str],
+) -> TensorFrame:
+    """Key columns + sorted output columns (`DebugRowOps.scala:583-598`)."""
+    from .schema import ScalarType
+
+    cols = []
+    for k, v in key_out.items():
+        v = np.asarray(v)
+        if v.size == 0 and v.dtype == object:
+            # a 0-row string-keyed aggregate (empty Spark/Arrow
+            # partition) must return an empty frame like the numeric
+            # case, not fail Column's empty-ragged dtype check
+            cols.append(Column(k, v, ScalarType.string))
+        else:
+            cols.append(Column(k, v))
+    cols += [Column(b, results[b]) for b in sorted(bases)]
+    return TensorFrame(cols)
+
+
+# Reduce roots the chunked plan can combine, and their partial combiners.
+_CHUNK_COMBINERS = {
+    "Sum": "sum",
+    "Min": "min",
+    "Max": "max",
+    "Prod": "prod",
+    "Mean": "mean",
+}
+
+# Ops that act row-locally (each output row depends only on the matching
+# input row and on sub-lead-rank constants) — safe between a placeholder
+# and the root reduce under chunking.
+_ROWWISE_OPS = {
+    "Identity", "StopGradient", "PreventGradient", "CheckNumerics",
+    "Snapshot", "Cast",
+    "Abs", "Neg", "Exp", "Log", "Log1p", "Sqrt", "Rsqrt", "Square",
+    "Sign", "Floor", "Ceil", "Round", "Relu", "Relu6", "Elu", "Selu",
+    "Softplus", "Softsign", "Sigmoid", "Tanh", "Sin", "Cos", "Tan",
+    "Erf", "Reciprocal",
+    "Add", "AddV2", "Sub", "Mul", "Div", "RealDiv", "TruncateDiv",
+    "FloorDiv", "Maximum", "Minimum", "Pow", "SquaredDifference", "Mod",
+    "FloorMod",
+}
+
+
+def _chunk_combiners(
+    graph: Graph, fetch_list: List[str], summary: GraphSummary,
+    require_direct: bool = False,
+) -> Optional[Dict[str, str]]:
+    """Classify each fetch as ``Reduce(rowwise(placeholder), axis=0)``.
+
+    Returns base -> combiner tag when EVERY fetch is a recognized monoid
+    reduce over the lead axis of a row-local transform of its
+    placeholder — the class the chunked plan computes exactly (chunk
+    partials combine with the derived monoid, size-weighted for Mean).
+    Returns None otherwise; callers then use the exact whole-group plan.
+    Structural, so transform-then-reduce graphs like ``Sum(x*x)`` chunk
+    correctly and unclassifiable graphs are never silently wrong.
+
+    ``require_direct`` additionally demands each reduce consume its
+    placeholder DIRECTLY (no transform in between) — the stricter class
+    for callers that recombine partials through the same graph (e.g.
+    `reduce_blocks_stream` tree-folding), where an interposed transform
+    would be re-applied to the partials.
+    """
+    out: Dict[str, str] = {}
+    for f in fetch_list:
+        try:
+            node = graph[_base(f)]
+        except KeyError:
+            return None
+        if node.op not in _CHUNK_COMBINERS:
+            return None
+        if bool(node.attr("keep_dims", node.attr("keepdims", False))):
+            return None
+        if (
+            node.op == "Mean"
+            and not summary.outputs[_base(f)].dtype.is_floating
+        ):
+            # integer Mean truncates per chunk (TF semantics: div of sum
+            # by count), so truncated partials cannot recombine exactly
+            return None
+        data_in = node.data_inputs()
+        if len(data_in) != 2:
+            return None
+        if require_direct and graph[data_in[0][0]].op not in (
+            "Placeholder", "PlaceholderV2"
+        ):
+            return None
+        idx_node = graph[data_in[1][0]]
+        if idx_node.op != "Const":
+            return None
+        axes = idx_node.attrs["value"].value.to_numpy().ravel().tolist()
+        if axes != [0]:
+            return None
+        # walk the transform subgraph: placeholder/const leaves, rowwise ops
+        seen = set()
+        stack = [data_in[0][0]]
+        ph_ranks = set()
+        const_shapes = []
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            n = graph[name]
+            if n.op in ("Placeholder", "PlaceholderV2"):
+                info = summary.inputs.get(name)
+                if info is None:
+                    return None
+                ph_ranks.add(len(info.shape.dims))
+                continue
+            if n.op == "Const":
+                const_shapes.append(
+                    n.attrs["value"].value.to_numpy().shape
+                )
+                continue
+            if n.op not in _ROWWISE_OPS:
+                return None
+            stack.extend(src for src, _ in n.data_inputs())
+        if len(ph_ranks) != 1:
+            return None  # mixed feed ranks: lead-axis alignment is murky
+        lead_rank = ph_ranks.pop()
+        for cshape in const_shapes:
+            # A lead-rank constant broadcasts along the group-size axis;
+            # chunked feeds slice that axis, so partials would mismatch
+            # (surfacing as an XLA broadcast error deep in the chunk
+            # stage). Only sub-lead-rank constants — or an explicit
+            # size-1 lead — are chunk-invariant; anything else falls
+            # back to the exact whole-group plan.
+            if len(cshape) > lead_rank or (
+                len(cshape) == lead_rank and cshape and cshape[0] != 1
+            ):
+                return None
+        out[_base(f)] = _CHUNK_COMBINERS[node.op]
+    return out
+
+
+def _gid_dtype(num_keys: int):
+    """Group-id dtype for the segment paths (host AND mesh — the mesh
+    path aliases this, `parallel/verbs.py`). int32 silently wraps past
+    2^31-1 DISTINCT KEYS — within 2x of the 1B+-row regime the north
+    star targets — so widen to int64 at the cliff. JAX without x64 mode
+    would silently downcast int64 ids back to int32, so that
+    configuration is refused loudly instead."""
+    if num_keys <= np.iinfo(np.int32).max:
+        return np.int32
+    if not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            f"aggregate: {num_keys} distinct keys overflows int32 group "
+            "ids and jax x64 is disabled (int64 ids would be silently "
+            "truncated); enable jax_enable_x64 for this key cardinality"
+        )
+    return np.int64
+
+
+def _aggregate_segment(
+    ex,
+    graph: Graph,
+    fetch_list: List[str],
+    combiners: Dict[str, str],
+    feed_names: List[str],
+    mapping: Dict[str, str],
+    grouped: GroupedFrame,
+) -> TensorFrame:
+    """Sort-free keyed aggregation for classified monoid graphs.
+
+    The rowwise transform of every fetch runs over ALL rows in one XLA
+    call, then one device ``segment_<op>`` per fetch produces the dense
+    (num_groups, *cell) result — no host argsort, no per-size or chunk
+    programs. This is the single-device analogue of the mesh path's
+    segment_sum+psum (`parallel/verbs.py`), generalized to min/max/prod
+    and size-weighted mean via the same structural classifier. FP
+    accumulation order differs from the whole-group exact plan (the
+    documented reassociation tolerance for reductions; the reference's
+    own driver-side pairwise combine reassociated too,
+    `DebugRowOps.scala:748-757`)."""
+    frame = grouped.frame
+    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
+    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
+    num_groups = len(next(iter(key_out.values())))
+    bases = [_base(f) for f in fetch_list]
+    # the data operand of each root reduce = the rowwise transform output
+    roots = [graph[_base(f)].data_inputs()[0][0] for f in fetch_list]
+    comb_sig = ",".join(combiners[b] for b in bases)
+
+    needs_counts = "mean" in combiners.values()
+
+    # TPU-first sum lowering: XLA turns segment_sum into scatter-add,
+    # which serializes on the TPU; for modest key counts a one-hot
+    # matmul computes the same dense table on the MXU
+    # (out[k] = sum_n onehot[n,k] * data[n] — one big matmul). Keys the
+    # cache entry because it changes the compiled program.
+    from . import config as _config
+
+    onehot_keys = _config.get().aggregate_onehot_keys
+    if onehot_keys is None:  # auto: only where scatter-add is the slow path
+        onehot_keys = 256 if jax.default_backend() == "tpu" else 0
+    # the one-hot operand is a dense (rows x keys) matrix XLA must
+    # materialize — bound the PRODUCT too, or a row count the scatter
+    # plan handled fine would OOM HBM (256M f32 elements = 1 GB). The
+    # decision is per CALL (row count varies across calls of one graph)
+    # and is part of the cache kind below, so plans never alias.
+    use_onehot = (
+        0 < num_groups <= int(onehot_keys)
+        and grouped.frame.nrows * num_groups <= 268_435_456
+    )
+
+    def make():
+        import jax.numpy as jnp
+
+        raw = build_callable(graph, roots, feed_names)
+        # sum/mean route through seg_sum above this table
+        segment_of = {
+            "min": jax.ops.segment_min,
+            "max": jax.ops.segment_max,
+            "prod": jax.ops.segment_prod,
+        }
+
+        def seg_sum(o, gid):
+            if not (use_onehot and jnp.issubdtype(o.dtype, jnp.floating)):
+                return jax.ops.segment_sum(o, gid, num_groups)
+            onehot = jax.nn.one_hot(gid, num_groups, dtype=o.dtype)
+            flat = o.reshape(o.shape[0], -1)
+            out = jax.lax.dot_general(
+                onehot, flat, (((0,), (0,)), ((), ())),
+                precision=_config.get().lax_precision(),
+            )
+            return out.reshape((num_groups,) + o.shape[1:])
+
+        def fn(gid, counts, *feeds):
+            outs = raw(*feeds)
+            res = []
+            for b, o in zip(bases, outs):
+                comb = combiners[b]
+                if comb == "mean":
+                    s = seg_sum(o, gid)
+                    c = counts.astype(o.dtype).reshape(
+                        (-1,) + (1,) * (s.ndim - 1)
+                    )
+                    res.append(s / c)
+                elif comb == "sum":
+                    res.append(seg_sum(o, gid))
+                else:
+                    res.append(segment_of[comb](o, gid, num_groups))
+            return tuple(res)
+
+        return jax.jit(fn)
+
+    sfn = ex.cached(
+        f"segagg-{num_groups}-{comb_sig}-{int(use_onehot)}",
+        graph, fetch_list, feed_names, make,
+    )
+    gid = inverse.astype(_gid_dtype(num_groups))
+    # counts ride as exact int32 and convert to the fetch dtype in-graph;
+    # the O(n) bincount is skipped entirely when no fetch is a Mean
+    counts = (
+        np.bincount(inverse, minlength=num_groups).astype(np.int32)
+        if needs_counts
+        else np.zeros(0, np.int32)
+    )
+    feeds = [frame.column(mapping[n]).values for n in feed_names]
+    outs = sfn(gid, counts, *feeds)
+    maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
+    results = {b: np.asarray(o) for b, o in zip(bases, outs)}
+    return _keyed_output(key_out, results, bases)
+
+
+def _monoid_combine(
+    tab: np.ndarray,
+    bounds: np.ndarray,
+    comb: str,
+    weights: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Combine partial-reduce segments with a derived monoid: one ufunc
+    reduceat over a flat partial table (segments delimited by ``bounds``).
+    ``weights`` (contributing row counts per partial) is required for
+    the size-weighted ``mean`` combine."""
+    if comb == "sum":
+        return np.add.reduceat(tab, bounds, axis=0)
+    if comb == "min":
+        return np.minimum.reduceat(tab, bounds, axis=0)
+    if comb == "max":
+        return np.maximum.reduceat(tab, bounds, axis=0)
+    if comb == "prod":
+        return np.multiply.reduceat(tab, bounds, axis=0)
+    if comb == "mean":
+        if weights is None:
+            raise ValueError("mean combine needs partial weights")
+        w = weights.reshape((-1,) + (1,) * (tab.ndim - 1))
+        num = np.add.reduceat(tab * w, bounds, axis=0)
+        den = np.add.reduceat(weights, bounds)
+        return (num / den.reshape((-1,) + (1,) * (tab.ndim - 1))).astype(
+            tab.dtype
+        )
+    raise AssertionError(f"unknown combiner {comb!r}")
+
+
+def _aggregate_chunked(
+    run: Callable,
+    feed_names: List[str],
+    col_data: Dict[str, np.ndarray],
+    counts: np.ndarray,
+    starts: np.ndarray,
+    num_groups: int,
+    bases: List[str],
+    combiners: Dict[str, str],
+    pad_quantum: int = 1,
+) -> Dict[str, np.ndarray]:
+    """Keyed aggregation by pow2 chunk decomposition + monoid combine.
+
+    The exact plan (one vmapped call per distinct group size) compiles
+    O(#distinct sizes) programs — a pathological key distribution with
+    all-distinct sizes compiles one program per group. Here each sorted
+    group splits into power-of-two chunks (binary decomposition of its
+    size, in row order); all chunks of one size run as ONE vmapped call
+    of the FULL graph (per-row transforms apply inside the chunk); then
+    each group's partials combine with the fetch's derived monoid — one
+    `np.ufunc.reduceat` over all groups per fetch, size-weighted for
+    Mean. Compile count: O(log max_size), independent of the size
+    distribution. Only graphs classified by `_chunk_combiners` reach
+    this plan, so results are exact, not merely associativity-approximate.
+
+    ``run(feeds)`` executes the vmapped graph on ``(n, size, *cell)``
+    feeds; lead dims are padded to ``pad_quantum * 2**k`` (mesh callers
+    pass the device count so every batched call shards evenly; padding
+    rows replicate real data and their outputs are discarded).
+    """
+    if num_groups == 0:
+        return {}
+    # 1. binary chunk decomposition of every sorted group, in row order
+    chunk_starts_by_p: Dict[int, List[int]] = {}
+    chunk_slots_by_p: Dict[int, List[int]] = {}
+    chunk_sizes: List[int] = []  # per global chunk slot, in group order
+    group_nchunks = np.zeros(num_groups, dtype=np.int64)
+    next_slot = 0
+    for g in range(num_groups):
+        s = int(counts[g])
+        pos = int(starts[g])
+        while s:
+            p = 1 << (s.bit_length() - 1)
+            chunk_starts_by_p.setdefault(p, []).append(pos)
+            chunk_slots_by_p.setdefault(p, []).append(next_slot)
+            chunk_sizes.append(p)
+            group_nchunks[g] += 1
+            next_slot += 1
+            pos += p
+            s -= p
+
+    def _padded(n: int) -> int:
+        q = pad_quantum
+        while q < n:
+            q *= 2
+        return q
+
+    # 2. chunk stage: one batched call per distinct pow2 chunk size;
+    #    results land in a flat per-fetch partial table (group order)
+    partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+    for p in sorted(chunk_starts_by_p, reverse=True):
+        starts_list = chunk_starts_by_p[p]
+        n_p = len(starts_list)
+        padded = _padded(n_p)
+        st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
+        row_idx = st[:, None] + np.arange(p)[None, :]
+        feeds = [col_data[n][row_idx] for n in feed_names]
+        outs = run(feeds)
+        maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
+        slots = np.asarray(chunk_slots_by_p[p])
+        for b, o in zip(bases, outs):
+            o = np.asarray(o)
+            if partials[b] is None:
+                partials[b] = np.empty(
+                    (next_slot,) + o.shape[1:], dtype=o.dtype
+                )
+            partials[b][slots] = o[:n_p]
+
+    # 3. combine: one reduceat per fetch over the flat partial tables
+    bounds = np.concatenate(
+        [[0], np.cumsum(group_nchunks)[:-1]]
+    ).astype(np.int64)
+    sizes = np.asarray(chunk_sizes, dtype=np.float64)
+    return {
+        b: _monoid_combine(partials[b], bounds, combiners[b], weights=sizes)
+        for b in bases
+    }
+
+

@@ -32,7 +32,6 @@ reduces, `DebugRowOps.scala:80-262`).
 
 from __future__ import annotations
 
-import inspect
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -42,7 +41,7 @@ from jax import lax
 from .frame import Column, TensorFrame, factorize_keys
 from .graph import builder as dsl
 from .graph.analysis import GraphSummary, ShapeHints, analyze_graph
-from .graph.ir import Graph, parse_edge
+from .graph.ir import Graph, base_name, parse_edge
 from .ops.lowering import build_callable
 from .runtime.executor import Executor, default_executor
 from .runtime.retry import maybe_check_numerics
@@ -137,8 +136,7 @@ def _as_graph(
     return freeze_variables(g), list(fetch_names)
 
 
-def _base(name: str) -> str:
-    return parse_edge(name)[0]
+_base = base_name
 
 
 # ---------------------------------------------------------------------------
@@ -313,15 +311,7 @@ def _empty_output(summary: GraphSummary, base: str, drop_lead: bool) -> np.ndarr
     return np.zeros(shape, dtype=info.dtype.np_dtype)
 
 
-def _empty_fn_outputs(jfn, feeds: List) -> Dict[str, np.ndarray]:
-    """Zero-row outputs for a function-front-end verb over an all-empty
-    frame: trace the jitted fn on zero-row feeds (shape-level only). The
-    lead dim is forced to 0 — a trimmed reduction traced on a zero-row
-    block can still report a nonzero lead (e.g. keepdims sums)."""
-    shapes = jax.eval_shape(jfn, *feeds)
-    return {
-        n: np.zeros((0,) + s.shape[1:], s.dtype) for n, s in shapes.items()
-    }
+# _empty_fn_outputs lives in fn_frontend.py (re-exported below)
 
 
 def _output_frame(
@@ -346,32 +336,7 @@ def _output_frame(
 # ---------------------------------------------------------------------------
 
 
-def _fn_feed_columns(
-    fn: Callable, frame: TensorFrame, bound: Optional[set] = None
-) -> List[str]:
-    params = [
-        p.name
-        for p in inspect.signature(fn).parameters.values()
-        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
-    ]
-    missing = [
-        p for p in params if p not in frame.info and p not in (bound or ())
-    ]
-    if missing:
-        raise ValueError(
-            f"function front-end: parameters {missing} have no matching "
-            f"columns (columns: {frame.columns})"
-        )
-    return params
-
-
-def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
-    if isinstance(res, dict):
-        return res
-    raise ValueError(
-        f"{what}: a function graph must return a dict of named output "
-        "arrays (output names become column names)"
-    )
+# _fn_feed_columns/_fn_outputs_to_dict live in fn_frontend.py
 
 
 # ---------------------------------------------------------------------------
@@ -622,69 +587,8 @@ def map_blocks(
     return _output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
 
 
-def _map_blocks_fn(
-    fn: Callable,
-    frame: TensorFrame,
-    trim: bool,
-    ex: Executor,
-    bindings: Optional[Dict[str, "np.ndarray"]] = None,
-) -> TensorFrame:
-    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
-    params = _fn_feed_columns(fn, frame, bound=set(bindings))
-    unknown = sorted(set(bindings) - set(params))
-    if unknown:
-        raise ValueError(
-            f"bindings {unknown} do not match any function parameter "
-            f"(parameters: {params})"
-        )
-    _require_dense(frame, [p for p in params if p not in bindings], "map_blocks")
-    jfn = jax.jit(lambda *args: _fn_outputs_to_dict(fn(*args), "map_blocks"))
-    acc: Dict[str, List[np.ndarray]] = {}
-    out_sizes: List[int] = []
-    for bi in range(frame.num_blocks):
-        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-        if lo == hi:
-            out_sizes.append(0)
-            continue
-        outs = jfn(
-            *[
-                bindings[p] if p in bindings else frame.column(p).values[lo:hi]
-                for p in params
-            ]
-        )
-        bsize = None
-        for name, o in outs.items():
-            if o.ndim == 0:
-                raise ValueError(
-                    f"map_blocks: output {name!r} must have a lead (row) dim"
-                    + ("" if trim else "; use trim=True for reductions")
-                )
-            if not trim and o.shape[0] != hi - lo:
-                raise ValueError(
-                    f"map_blocks: output {name!r} does not preserve the "
-                    "block row count; use trim=True"
-                )
-            if trim:
-                if bsize is None:
-                    bsize = o.shape[0]
-                elif o.shape[0] != bsize:
-                    raise ValueError(
-                        "map_blocks(trim): outputs disagree on row count"
-                    )
-            acc.setdefault(name, []).append(o)
-        out_sizes.append(bsize if trim else hi - lo)
-    if not acc:  # every block empty: zero-row outputs, names from a trace
-        empties = _empty_fn_outputs(
-            jfn,
-            [
-                bindings[p] if p in bindings else frame.column(p).values[:0]
-                for p in params
-            ],
-        )
-        acc = {n: [v] for n, v in empties.items()}
-    out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
-    offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
-    return _output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
+# function front-end kernels + ragged bucketing live in
+# fn_frontend.py; re-exported at the end of this module.
 
 
 # ---------------------------------------------------------------------------
@@ -692,94 +596,7 @@ def _map_blocks_fn(
 # ---------------------------------------------------------------------------
 
 
-def _run_ragged_bucketed(
-    vfn,
-    columns: List[Column],
-    nrows: int,
-    out_names_hint: Optional[List[str]] = None,
-    defer: bool = False,
-) -> Dict[str, List[np.ndarray]]:
-    """Shape-bucketed execution for ragged rows: group rows by their joint
-    cell-shape signature, run ONE vmapped XLA call per bucket, scatter the
-    results back in row order.
-
-    This is the shape-bucketing plan of SURVEY §7 "hard parts" — the ragged
-    analogue of the reference's per-row variable-length support
-    (`TFDataOps.scala:90-103`) without its one-session.run-per-row cost.
-    Bucket sizes are padded to the next power of two (duplicating the last
-    row; padded outputs discarded) so the compile count is bounded by
-    O(#distinct cell shapes x log max bucket) instead of O(#rows).
-
-    ``vfn`` is a vmapped callable returning either a tuple (graph path,
-    ``out_names_hint`` gives the names) or a dict (function front-end).
-    Returns name -> list of per-row output cells (row order).
-
-    ``defer=True`` returns the raw chunk pairs (name -> [(row indices,
-    DEVICE array)]) without assembling: the mesh ragged path
-    (`parallel.verbs._ragged_per_shard`) runs this once per device and
-    must not block on device-to-host transfer between shards — it
-    collects every shard's chunks and assembles once at the end via
-    `_assemble_ragged`.
-    """
-    cells = [c.values if c.is_dense else c.ragged for c in columns]
-    buckets: Dict[Tuple, List[int]] = {}
-    for i in range(nrows):
-        key = tuple(cc[i].shape for cc in cells)
-        buckets.setdefault(key, []).append(i)
-
-    # (idxs, chunk) pairs per output name; assembled dense below when all
-    # buckets agree on the output cell shape, else per-row (ragged result)
-    chunks: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
-    for idxs in buckets.values():
-        nb = len(idxs)
-        padded = 1 << (nb - 1).bit_length()
-        take = idxs + [idxs[-1]] * (padded - nb)
-        feeds = [
-            cc[np.asarray(take)]
-            if col.is_dense
-            else np.stack([cc[i] for i in take])
-            for col, cc in zip(columns, cells)
-        ]
-        outs = vfn(*feeds)
-        if not isinstance(outs, dict):
-            outs = dict(zip(out_names_hint, outs))
-        idx_arr = np.asarray(idxs)
-        for name, o in outs.items():
-            # keep the DEVICE array (slicing is lazy): converting here
-            # would block on transfer before the next bucket dispatches,
-            # serializing the whole plan — with per-shard device
-            # placement (parallel.verbs._ragged_per_shard) every
-            # device's buckets must be in flight before any fetch
-            chunks.setdefault(name, []).append((idx_arr, o[:nb]))
-
-    if defer:
-        return chunks
-    return _assemble_ragged(chunks, nrows)
-
-
-def _assemble_ragged(
-    chunks: Dict[str, List[Tuple[np.ndarray, "jax.Array"]]], nrows: int
-) -> Dict[str, Union[np.ndarray, List[np.ndarray]]]:
-    """Scatter bucketed chunk outputs back into row order. Device->host
-    conversion happens HERE, after every bucket (and, for the mesh path,
-    every shard's device) has been dispatched."""
-    per_row: Dict[str, Union[np.ndarray, List[np.ndarray]]] = {}
-    for name, pairs in chunks.items():
-        cell_shapes = {o.shape[1:] for _, o in pairs}
-        if len(cell_shapes) == 1:  # uniform outputs: one dense scatter
-            shape = next(iter(cell_shapes))
-            res = np.empty((nrows,) + shape, dtype=pairs[0][1].dtype)
-            for idx_arr, o in pairs:
-                res[idx_arr] = np.asarray(o)
-            per_row[name] = res
-        else:
-            rows: List[Optional[np.ndarray]] = [None] * nrows
-            for idx_arr, o in pairs:
-                o = np.asarray(o)
-                for j, i in enumerate(idx_arr):
-                    rows[i] = o[j]
-            per_row[name] = rows
-    return per_row
+# ragged bucketing lives in fn_frontend.py (re-exported below)
 
 
 @_pandas_in_out
@@ -816,7 +633,7 @@ def map_rows(
                 fetches, frame, mesh, feed_dict, fetch_names, executor,
                 bindings=bindings,
             )
-        return _map_rows_fn(fetches, frame, bindings=bindings)
+        return _map_rows_fn(fetches, frame, ex, bindings=bindings)
     graph, fetch_list = _as_graph(fetches, fetch_names)
     graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
     if str_pass:
@@ -940,86 +757,7 @@ def map_rows(
     return _output_frame(frame, out_cols, append_input=True)
 
 
-def _map_rows_fn(
-    fn: Callable,
-    frame: TensorFrame,
-    bindings: Optional[Dict[str, "np.ndarray"]] = None,
-) -> TensorFrame:
-    """Function front-end for map_rows: fn(cell, ...) -> dict of outputs.
-
-    jit/vmap preserve dict outputs, so output names come from the traced
-    dict directly — the user function is invoked exactly once per trace.
-    ``bindings`` match function PARAMETER names and are held constant
-    across rows (vmap in_axes=None), like the graph front-end.
-    """
-    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
-    params = _fn_feed_columns(fn, frame, bound=set(bindings))
-    unknown = sorted(set(bindings) - set(params))
-    if unknown:
-        raise ValueError(
-            f"bindings {unknown} do not match any function parameter "
-            f"(parameters: {params})"
-        )
-    col_params = [p for p in params if p not in bindings]
-    if bindings and not col_params:
-        raise ValueError(
-            "map_rows: every parameter is bound, so nothing varies per "
-            "row; use map_blocks (or call the function directly)"
-        )
-    dense = all(frame.column(p).is_dense for p in col_params)
-    if bindings and not dense:
-        raise ValueError(
-            "map_rows: bindings are not supported with ragged feed "
-            "columns; densify the columns or bake the values as constants"
-        )
-
-    def wrapped(*cells):
-        return _fn_outputs_to_dict(fn(*cells), "map_rows")
-
-    def _feeds(lo, hi):
-        return [
-            bindings[p] if p in bindings else frame.column(p).values[lo:hi]
-            for p in params
-        ]
-
-    acc: Dict[str, List[np.ndarray]] = {}
-    if dense:
-        in_axes = tuple(None if p in bindings else 0 for p in params)
-        vfn = jax.jit(jax.vmap(wrapped, in_axes=in_axes))
-        for bi in range(frame.num_blocks):
-            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-            if lo == hi:
-                continue
-            outs = vfn(*_feeds(lo, hi))
-            for n, o in outs.items():
-                acc.setdefault(n, []).append(o)
-        if not acc:
-            empties = _empty_fn_outputs(vfn, _feeds(0, 0))
-            acc = {n: [v] for n, v in empties.items()}
-        out_cols = [Column(n, _concat_parts(parts)) for n, parts in acc.items()]
-    else:
-        vfn = jax.jit(jax.vmap(wrapped))
-        if frame.nrows == 0:
-            # 0-row ragged columns: synthesize zero-row feeds from the
-            # declared cell shapes (unknown dims collapse to 0)
-            feeds = [
-                np.zeros(
-                    (0,)
-                    + tuple(
-                        0 if d is None else d
-                        for d in frame.column(p).cell_shape.dims
-                    ),
-                    dtype=frame.column(p).dtype.np_dtype,
-                )
-                for p in params
-            ]
-            per_out = {n: v for n, v in _empty_fn_outputs(vfn, feeds).items()}
-        else:
-            per_out = _run_ragged_bucketed(
-                vfn, [frame.column(p) for p in params], frame.nrows
-            )
-        out_cols = [Column(n, vals) for n, vals in per_out.items()]
-    return _output_frame(frame, out_cols, append_input=True)
+# _map_rows_fn lives in fn_frontend.py (re-exported below)
 
 
 # ---------------------------------------------------------------------------
@@ -1130,146 +868,9 @@ def reduce_blocks(
     return {_base(f): v for f, v in zip(fetch_list, final)}
 
 
-def _prefetch_iter(it, depth: int = 1):
-    """Pull ``it`` on a daemon thread, ``depth`` items ahead. The consumer
-    (device execution) and the producer (chunk synthesis / host IO) then
-    overlap — the streaming analogue of Spark's pipelined partition fetch."""
-    import queue
-    import threading
-
-    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
-    _END = object()
-    cancelled = threading.Event()
-
-    def _put(msg) -> bool:
-        # Bounded put that gives up when the consumer abandoned the
-        # generator — otherwise the producer thread would block forever
-        # on the full queue, pinning the buffered chunks in memory.
-        while not cancelled.is_set():
-            try:
-                q.put(msg, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer():
-        try:
-            for item in it:
-                if not _put(("item", item)):
-                    return
-        except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
-            _put(("error", e))
-            return
-        _put(("end", _END))
-
-    threading.Thread(target=producer, daemon=True).start()
-    try:
-        while True:
-            kind, payload = q.get()
-            if kind == "error":
-                raise payload
-            if kind == "end":
-                return
-            yield payload
-    finally:
-        cancelled.set()
-        while not q.empty():  # release buffered chunks promptly
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
-
-
-def reduce_blocks_stream(
-    fetches: Fetches,
-    frames,
-    feed_dict: Optional[Dict[str, str]] = None,
-    fetch_names: Optional[Sequence[str]] = None,
-    executor: Optional[Executor] = None,
-    mesh=None,
-    fold_every="auto",
-):
-    """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
-    hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
-    background prefetch thread while chunk N reduces on device, so host
-    synthesis/IO overlaps device execution; partials combine with the
-    same graph.
-
-    The partial table itself is tree-folded every ``fold_every`` chunks,
-    so host memory is bounded by O(fold_every) partials no matter how
-    long the stream — the streaming form is what makes the BASELINE
-    north star (1B-row vector reduce_sum) run in bounded host memory
-    unconditionally.
-
-    Combining partials through the same graph assumes the reduce is
-    ASSOCIATIVE over blocks (sum/min/max/...) — the same contract as the
-    reference's pairwise partial combine (`reducePairBlock`,
-    `DebugRowOps.scala:748-757`). A non-associative graph (e.g. Mean:
-    a fold result re-enters the next combine weighted as ONE chunk) is
-    not exact under tree-folding, so the default ``fold_every="auto"``
-    enables tree-folding (every 64 chunks) ONLY when every fetch is an
-    associative monoid reduce (sum/min/max/prod) consuming its
-    placeholder DIRECTLY — partials recombine through the same graph,
-    so any transform between placeholder and reduce (``Sum(x*x)``)
-    would be re-applied to the partials at each fold. Mean,
-    transform-then-reduce, and unclassifiable graphs fall back to the
-    single equally-weighted final combine at the cost of O(#chunks)
-    host memory. Pass an int to force a fold cadence, or ``None`` to
-    force the single final combine.
-    """
-    graph, fetch_list = _as_graph(fetches, fetch_names)
-    auto_fold = fold_every == "auto"
-    if auto_fold:
-        fold_every = None  # resolved from the first chunk's analysis below
-    if fold_every is not None:
-        fold_every = max(2, int(fold_every))
-
-    def _combine(parts: List[Dict]) -> Dict:
-        stacked = TensorFrame.from_dict(
-            {
-                b: np.stack([np.asarray(p[b]) for p in parts])
-                for b in parts[0]
-            }
-        )
-        r = reduce_blocks(
-            graph, stacked, None, fetch_names=fetch_list, executor=executor
-        )
-        return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
-
-    partials: List[Dict] = []
-    for f in _prefetch_iter(frames):
-        if auto_fold:
-            # classify once, on the first chunk: tree-fold only graphs
-            # proven associative (sum/min/max/prod monoids); anything
-            # else keeps every partial for one exact final combine
-            auto_fold = False
-            try:
-                ov = _ph_overrides(graph, f, feed_dict, block_level=True)
-                s = analyze_graph(graph, fetch_list, placeholder_shapes=ov)
-                # require_direct: partials recombine through the same
-                # graph here, so an interposed transform (Sum(x*x))
-                # would be re-applied at every fold
-                comb = _chunk_combiners(
-                    graph, fetch_list, s, require_direct=True
-                )
-                if comb is not None and "mean" not in comb.values():
-                    fold_every = 64
-            except Exception:
-                pass  # conservative: no folding when classification fails
-        r = reduce_blocks(
-            graph, f, feed_dict, fetch_names=fetch_list,
-            executor=executor, mesh=mesh,
-        )
-        partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
-        if fold_every is not None and len(partials) >= fold_every:
-            partials = [_combine(partials)]
-    if not partials:
-        raise ValueError("reduce_blocks_stream over an empty iterator")
-    out = partials[0] if len(partials) == 1 else _combine(partials)
-    if len(fetch_list) == 1:
-        return out[_base(fetch_list[0])]
-    return out
+# Streaming reduce lives in streaming.py; re-exported here so the
+# public surface (and api._prefetch_iter-style internal references)
+# are unchanged. Import is at the END of this module (late-bound).
 
 
 # ---------------------------------------------------------------------------
@@ -1422,413 +1023,19 @@ def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
     return GroupedFrame(frame, keys)
 
 
-def _group_plan(
-    grouped: GroupedFrame,
-    mapping: Dict[str, str],
-    feed_names: List[str],
-):
-    """Shared keyed-aggregation prologue: factorize keys, sort rows by
-    group, gather sorted feed columns. Returns
-    ``(key_out, num_groups, counts, starts, col_data)`` — the one copy of
-    the Catalyst-shuffle analogue both the host and mesh paths use."""
-    frame = grouped.frame
-    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
-    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
-    num_groups = len(next(iter(key_out.values())))
-    order = np.argsort(inverse, kind="stable")
-    counts = np.bincount(inverse, minlength=num_groups)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-    col_data = {n: frame.column(mapping[n]).values[order] for n in feed_names}
-    return key_out, num_groups, counts, starts, col_data
-
-
-def _keyed_output(
-    key_out: Dict[str, np.ndarray],
-    results: Dict[str, np.ndarray],
-    bases: List[str],
-) -> TensorFrame:
-    """Key columns + sorted output columns (`DebugRowOps.scala:583-598`)."""
-    from .schema import ScalarType
-
-    cols = []
-    for k, v in key_out.items():
-        v = np.asarray(v)
-        if v.size == 0 and v.dtype == object:
-            # a 0-row string-keyed aggregate (empty Spark/Arrow
-            # partition) must return an empty frame like the numeric
-            # case, not fail Column's empty-ragged dtype check
-            cols.append(Column(k, v, ScalarType.string))
-        else:
-            cols.append(Column(k, v))
-    cols += [Column(b, results[b]) for b in sorted(bases)]
-    return TensorFrame(cols)
-
-
-# Reduce roots the chunked plan can combine, and their partial combiners.
-_CHUNK_COMBINERS = {
-    "Sum": "sum",
-    "Min": "min",
-    "Max": "max",
-    "Prod": "prod",
-    "Mean": "mean",
-}
-
-# Ops that act row-locally (each output row depends only on the matching
-# input row and on sub-lead-rank constants) — safe between a placeholder
-# and the root reduce under chunking.
-_ROWWISE_OPS = {
-    "Identity", "StopGradient", "PreventGradient", "CheckNumerics",
-    "Snapshot", "Cast",
-    "Abs", "Neg", "Exp", "Log", "Log1p", "Sqrt", "Rsqrt", "Square",
-    "Sign", "Floor", "Ceil", "Round", "Relu", "Relu6", "Elu", "Selu",
-    "Softplus", "Softsign", "Sigmoid", "Tanh", "Sin", "Cos", "Tan",
-    "Erf", "Reciprocal",
-    "Add", "AddV2", "Sub", "Mul", "Div", "RealDiv", "TruncateDiv",
-    "FloorDiv", "Maximum", "Minimum", "Pow", "SquaredDifference", "Mod",
-    "FloorMod",
-}
-
-
-def _chunk_combiners(
-    graph: Graph, fetch_list: List[str], summary: GraphSummary,
-    require_direct: bool = False,
-) -> Optional[Dict[str, str]]:
-    """Classify each fetch as ``Reduce(rowwise(placeholder), axis=0)``.
-
-    Returns base -> combiner tag when EVERY fetch is a recognized monoid
-    reduce over the lead axis of a row-local transform of its
-    placeholder — the class the chunked plan computes exactly (chunk
-    partials combine with the derived monoid, size-weighted for Mean).
-    Returns None otherwise; callers then use the exact whole-group plan.
-    Structural, so transform-then-reduce graphs like ``Sum(x*x)`` chunk
-    correctly and unclassifiable graphs are never silently wrong.
-
-    ``require_direct`` additionally demands each reduce consume its
-    placeholder DIRECTLY (no transform in between) — the stricter class
-    for callers that recombine partials through the same graph (e.g.
-    `reduce_blocks_stream` tree-folding), where an interposed transform
-    would be re-applied to the partials.
-    """
-    out: Dict[str, str] = {}
-    for f in fetch_list:
-        try:
-            node = graph[_base(f)]
-        except KeyError:
-            return None
-        if node.op not in _CHUNK_COMBINERS:
-            return None
-        if bool(node.attr("keep_dims", node.attr("keepdims", False))):
-            return None
-        if (
-            node.op == "Mean"
-            and not summary.outputs[_base(f)].dtype.is_floating
-        ):
-            # integer Mean truncates per chunk (TF semantics: div of sum
-            # by count), so truncated partials cannot recombine exactly
-            return None
-        data_in = node.data_inputs()
-        if len(data_in) != 2:
-            return None
-        if require_direct and graph[data_in[0][0]].op not in (
-            "Placeholder", "PlaceholderV2"
-        ):
-            return None
-        idx_node = graph[data_in[1][0]]
-        if idx_node.op != "Const":
-            return None
-        axes = idx_node.attrs["value"].value.to_numpy().ravel().tolist()
-        if axes != [0]:
-            return None
-        # walk the transform subgraph: placeholder/const leaves, rowwise ops
-        seen = set()
-        stack = [data_in[0][0]]
-        ph_ranks = set()
-        const_shapes = []
-        while stack:
-            name = stack.pop()
-            if name in seen:
-                continue
-            seen.add(name)
-            n = graph[name]
-            if n.op in ("Placeholder", "PlaceholderV2"):
-                info = summary.inputs.get(name)
-                if info is None:
-                    return None
-                ph_ranks.add(len(info.shape.dims))
-                continue
-            if n.op == "Const":
-                const_shapes.append(
-                    n.attrs["value"].value.to_numpy().shape
-                )
-                continue
-            if n.op not in _ROWWISE_OPS:
-                return None
-            stack.extend(src for src, _ in n.data_inputs())
-        if len(ph_ranks) != 1:
-            return None  # mixed feed ranks: lead-axis alignment is murky
-        lead_rank = ph_ranks.pop()
-        for cshape in const_shapes:
-            # A lead-rank constant broadcasts along the group-size axis;
-            # chunked feeds slice that axis, so partials would mismatch
-            # (surfacing as an XLA broadcast error deep in the chunk
-            # stage). Only sub-lead-rank constants — or an explicit
-            # size-1 lead — are chunk-invariant; anything else falls
-            # back to the exact whole-group plan.
-            if len(cshape) > lead_rank or (
-                len(cshape) == lead_rank and cshape and cshape[0] != 1
-            ):
-                return None
-        out[_base(f)] = _CHUNK_COMBINERS[node.op]
-    return out
-
-
-def _gid_dtype(num_keys: int):
-    """Group-id dtype for the segment paths (host AND mesh — the mesh
-    path aliases this, `parallel/verbs.py`). int32 silently wraps past
-    2^31-1 DISTINCT KEYS — within 2x of the 1B+-row regime the north
-    star targets — so widen to int64 at the cliff. JAX without x64 mode
-    would silently downcast int64 ids back to int32, so that
-    configuration is refused loudly instead."""
-    if num_keys <= np.iinfo(np.int32).max:
-        return np.int32
-    if not jax.config.read("jax_enable_x64"):
-        raise ValueError(
-            f"aggregate: {num_keys} distinct keys overflows int32 group "
-            "ids and jax x64 is disabled (int64 ids would be silently "
-            "truncated); enable jax_enable_x64 for this key cardinality"
-        )
-    return np.int64
-
-
-def _aggregate_segment(
-    ex,
-    graph: Graph,
-    fetch_list: List[str],
-    combiners: Dict[str, str],
-    feed_names: List[str],
-    mapping: Dict[str, str],
-    grouped: GroupedFrame,
-) -> TensorFrame:
-    """Sort-free keyed aggregation for classified monoid graphs.
-
-    The rowwise transform of every fetch runs over ALL rows in one XLA
-    call, then one device ``segment_<op>`` per fetch produces the dense
-    (num_groups, *cell) result — no host argsort, no per-size or chunk
-    programs. This is the single-device analogue of the mesh path's
-    segment_sum+psum (`parallel/verbs.py`), generalized to min/max/prod
-    and size-weighted mean via the same structural classifier. FP
-    accumulation order differs from the whole-group exact plan (the
-    documented reassociation tolerance for reductions; the reference's
-    own driver-side pairwise combine reassociated too,
-    `DebugRowOps.scala:748-757`)."""
-    frame = grouped.frame
-    key_arrays = [frame.column(k).host_values() for k in grouped.keys]
-    key_out, inverse = factorize_keys(grouped.keys, key_arrays)
-    num_groups = len(next(iter(key_out.values())))
-    bases = [_base(f) for f in fetch_list]
-    # the data operand of each root reduce = the rowwise transform output
-    roots = [graph[_base(f)].data_inputs()[0][0] for f in fetch_list]
-    comb_sig = ",".join(combiners[b] for b in bases)
-
-    needs_counts = "mean" in combiners.values()
-
-    # TPU-first sum lowering: XLA turns segment_sum into scatter-add,
-    # which serializes on the TPU; for modest key counts a one-hot
-    # matmul computes the same dense table on the MXU
-    # (out[k] = sum_n onehot[n,k] * data[n] — one big matmul). Keys the
-    # cache entry because it changes the compiled program.
-    from . import config as _config
-
-    onehot_keys = _config.get().aggregate_onehot_keys
-    if onehot_keys is None:  # auto: only where scatter-add is the slow path
-        onehot_keys = 256 if jax.default_backend() == "tpu" else 0
-    # the one-hot operand is a dense (rows x keys) matrix XLA must
-    # materialize — bound the PRODUCT too, or a row count the scatter
-    # plan handled fine would OOM HBM (256M f32 elements = 1 GB). The
-    # decision is per CALL (row count varies across calls of one graph)
-    # and is part of the cache kind below, so plans never alias.
-    use_onehot = (
-        0 < num_groups <= int(onehot_keys)
-        and grouped.frame.nrows * num_groups <= 268_435_456
-    )
-
-    def make():
-        import jax.numpy as jnp
-
-        raw = build_callable(graph, roots, feed_names)
-        # sum/mean route through seg_sum above this table
-        segment_of = {
-            "min": jax.ops.segment_min,
-            "max": jax.ops.segment_max,
-            "prod": jax.ops.segment_prod,
-        }
-
-        def seg_sum(o, gid):
-            if not (use_onehot and jnp.issubdtype(o.dtype, jnp.floating)):
-                return jax.ops.segment_sum(o, gid, num_groups)
-            onehot = jax.nn.one_hot(gid, num_groups, dtype=o.dtype)
-            flat = o.reshape(o.shape[0], -1)
-            out = jax.lax.dot_general(
-                onehot, flat, (((0,), (0,)), ((), ())),
-                precision=_config.get().lax_precision(),
-            )
-            return out.reshape((num_groups,) + o.shape[1:])
-
-        def fn(gid, counts, *feeds):
-            outs = raw(*feeds)
-            res = []
-            for b, o in zip(bases, outs):
-                comb = combiners[b]
-                if comb == "mean":
-                    s = seg_sum(o, gid)
-                    c = counts.astype(o.dtype).reshape(
-                        (-1,) + (1,) * (s.ndim - 1)
-                    )
-                    res.append(s / c)
-                elif comb == "sum":
-                    res.append(seg_sum(o, gid))
-                else:
-                    res.append(segment_of[comb](o, gid, num_groups))
-            return tuple(res)
-
-        return jax.jit(fn)
-
-    sfn = ex.cached(
-        f"segagg-{num_groups}-{comb_sig}-{int(use_onehot)}",
-        graph, fetch_list, feed_names, make,
-    )
-    gid = inverse.astype(_gid_dtype(num_groups))
-    # counts ride as exact int32 and convert to the fetch dtype in-graph;
-    # the O(n) bincount is skipped entirely when no fetch is a Mean
-    counts = (
-        np.bincount(inverse, minlength=num_groups).astype(np.int32)
-        if needs_counts
-        else np.zeros(0, np.int32)
-    )
-    feeds = [frame.column(mapping[n]).values for n in feed_names]
-    outs = sfn(gid, counts, *feeds)
-    maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
-    results = {b: np.asarray(o) for b, o in zip(bases, outs)}
-    return _keyed_output(key_out, results, bases)
-
-
-def _monoid_combine(
-    tab: np.ndarray,
-    bounds: np.ndarray,
-    comb: str,
-    weights: Optional[np.ndarray] = None,
-) -> np.ndarray:
-    """Combine partial-reduce segments with a derived monoid: one ufunc
-    reduceat over a flat partial table (segments delimited by ``bounds``).
-    ``weights`` (contributing row counts per partial) is required for
-    the size-weighted ``mean`` combine."""
-    if comb == "sum":
-        return np.add.reduceat(tab, bounds, axis=0)
-    if comb == "min":
-        return np.minimum.reduceat(tab, bounds, axis=0)
-    if comb == "max":
-        return np.maximum.reduceat(tab, bounds, axis=0)
-    if comb == "prod":
-        return np.multiply.reduceat(tab, bounds, axis=0)
-    if comb == "mean":
-        if weights is None:
-            raise ValueError("mean combine needs partial weights")
-        w = weights.reshape((-1,) + (1,) * (tab.ndim - 1))
-        num = np.add.reduceat(tab * w, bounds, axis=0)
-        den = np.add.reduceat(weights, bounds)
-        return (num / den.reshape((-1,) + (1,) * (tab.ndim - 1))).astype(
-            tab.dtype
-        )
-    raise AssertionError(f"unknown combiner {comb!r}")
-
-
-def _aggregate_chunked(
-    run: Callable,
-    feed_names: List[str],
-    col_data: Dict[str, np.ndarray],
-    counts: np.ndarray,
-    starts: np.ndarray,
-    num_groups: int,
-    bases: List[str],
-    combiners: Dict[str, str],
-    pad_quantum: int = 1,
-) -> Dict[str, np.ndarray]:
-    """Keyed aggregation by pow2 chunk decomposition + monoid combine.
-
-    The exact plan (one vmapped call per distinct group size) compiles
-    O(#distinct sizes) programs — a pathological key distribution with
-    all-distinct sizes compiles one program per group. Here each sorted
-    group splits into power-of-two chunks (binary decomposition of its
-    size, in row order); all chunks of one size run as ONE vmapped call
-    of the FULL graph (per-row transforms apply inside the chunk); then
-    each group's partials combine with the fetch's derived monoid — one
-    `np.ufunc.reduceat` over all groups per fetch, size-weighted for
-    Mean. Compile count: O(log max_size), independent of the size
-    distribution. Only graphs classified by `_chunk_combiners` reach
-    this plan, so results are exact, not merely associativity-approximate.
-
-    ``run(feeds)`` executes the vmapped graph on ``(n, size, *cell)``
-    feeds; lead dims are padded to ``pad_quantum * 2**k`` (mesh callers
-    pass the device count so every batched call shards evenly; padding
-    rows replicate real data and their outputs are discarded).
-    """
-    if num_groups == 0:
-        return {}
-    # 1. binary chunk decomposition of every sorted group, in row order
-    chunk_starts_by_p: Dict[int, List[int]] = {}
-    chunk_slots_by_p: Dict[int, List[int]] = {}
-    chunk_sizes: List[int] = []  # per global chunk slot, in group order
-    group_nchunks = np.zeros(num_groups, dtype=np.int64)
-    next_slot = 0
-    for g in range(num_groups):
-        s = int(counts[g])
-        pos = int(starts[g])
-        while s:
-            p = 1 << (s.bit_length() - 1)
-            chunk_starts_by_p.setdefault(p, []).append(pos)
-            chunk_slots_by_p.setdefault(p, []).append(next_slot)
-            chunk_sizes.append(p)
-            group_nchunks[g] += 1
-            next_slot += 1
-            pos += p
-            s -= p
-
-    def _padded(n: int) -> int:
-        q = pad_quantum
-        while q < n:
-            q *= 2
-        return q
-
-    # 2. chunk stage: one batched call per distinct pow2 chunk size;
-    #    results land in a flat per-fetch partial table (group order)
-    partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
-    for p in sorted(chunk_starts_by_p, reverse=True):
-        starts_list = chunk_starts_by_p[p]
-        n_p = len(starts_list)
-        padded = _padded(n_p)
-        st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
-        row_idx = st[:, None] + np.arange(p)[None, :]
-        feeds = [col_data[n][row_idx] for n in feed_names]
-        outs = run(feeds)
-        maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
-        slots = np.asarray(chunk_slots_by_p[p])
-        for b, o in zip(bases, outs):
-            o = np.asarray(o)
-            if partials[b] is None:
-                partials[b] = np.empty(
-                    (next_slot,) + o.shape[1:], dtype=o.dtype
-                )
-            partials[b][slots] = o[:n_p]
-
-    # 3. combine: one reduceat per fetch over the flat partial tables
-    bounds = np.concatenate(
-        [[0], np.cumsum(group_nchunks)[:-1]]
-    ).astype(np.int64)
-    sizes = np.asarray(chunk_sizes, dtype=np.float64)
-    return {
-        b: _monoid_combine(partials[b], bounds, combiners[b], weights=sizes)
-        for b in bases
-    }
+# The three aggregation plans live in aggregate.py (segment ops /
+# exact per-size vmap / pow2-chunk monoid combine); re-exported below
+# so parallel/verbs.py and parallel/multihost.py keep resolving them
+# through this module.
+from .aggregate import (  # noqa: E402
+    _aggregate_chunked,
+    _aggregate_segment,
+    _chunk_combiners,
+    _gid_dtype,
+    _group_plan,
+    _keyed_output,
+    _monoid_combine,
+)
 
 
 def aggregate(
@@ -1982,93 +1189,7 @@ def explain_detailed(frame: TensorFrame):
     return frame.info
 
 
-def _lower_for_inspection(
-    fetches: Fetches,
-    frame: TensorFrame,
-    feed_dict: Optional[Dict[str, str]],
-    fetch_names: Optional[Sequence[str]],
-    what: str,
-):
-    """Shared plumbing for `cost_analysis` / `explain_hlo`: lower the
-    exact program `map_blocks` would run for the first non-empty block."""
-    if _is_pandas(frame):
-        frame = TensorFrame.from_pandas(frame)
-    graph, fetch_list = _as_graph(fetches, fetch_names)
-    overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
-    summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
-    mapping = _match_columns(summary, frame, feed_dict, block_level=True)
-    _require_dense(frame, list(mapping.values()), what)
-    feed_names = sorted(summary.inputs)
-    from .ops.lowering import build_callable as _bc
-
-    fn = _bc(graph, fetch_list, feed_names)
-    for bi in range(frame.num_blocks):
-        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-        if lo != hi:
-            break
-    else:
-        raise ValueError(f"{what}: frame has no non-empty block")
-    feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
-    return jax.jit(fn).lower(*feeds), hi - lo
-
-
-def explain_hlo(
-    fetches: Fetches,
-    frame: TensorFrame,
-    feed_dict: Optional[Dict[str, str]] = None,
-    fetch_names: Optional[Sequence[str]] = None,
-    optimized: bool = False,
-) -> str:
-    """The HLO text of the program `map_blocks` would run — StableHLO as
-    lowered (default) or the backend-optimized HLO after XLA's fusion
-    passes (``optimized=True``). The inspection surface the reference
-    could not offer (its executor was an opaque libtensorflow session);
-    pairs with `cost_analysis` for the quantitative view.
-    """
-    lowered, _ = _lower_for_inspection(
-        fetches, frame, feed_dict, fetch_names, what="explain_hlo"
-    )
-    if optimized:
-        return lowered.compile().as_text()
-    return lowered.as_text()
-
-
-def cost_analysis(
-    fetches: Fetches,
-    frame: TensorFrame,
-    feed_dict: Optional[Dict[str, str]] = None,
-    fetch_names: Optional[Sequence[str]] = None,
-) -> Dict[str, float]:
-    """XLA's cost model for the compiled program `map_blocks` would run.
-
-    The reference's protos carry `StepStats`/`NodeExecStats` but nothing
-    consumes them (SURVEY §5 "tracing: absent"); here the compiler itself
-    is the cost oracle. Returns per-block-call estimates from the
-    compiled executable: ``flops``, ``bytes_accessed`` (HBM traffic),
-    ``argument_bytes``/``output_bytes``/``temp_bytes`` (from the memory
-    analysis), plus ``block_rows`` and derived ``flops_per_row`` — enough
-    to predict MXU vs HBM-bandwidth-bound behavior before running at
-    scale. The compile is cached by jax, so a following `map_blocks`
-    call reuses it.
-    """
-    lowered, rows = _lower_for_inspection(
-        fetches, frame, feed_dict, fetch_names, what="cost_analysis"
-    )
-    compiled = lowered.compile()
-    ca = compiled.cost_analysis() or {}
-    mem = compiled.memory_analysis()
-    flops = float(ca.get("flops", 0.0))
-    return {
-        "flops": flops,
-        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
-        "argument_bytes": float(
-            getattr(mem, "argument_size_in_bytes", 0) or 0
-        ),
-        "output_bytes": float(getattr(mem, "output_size_in_bytes", 0) or 0),
-        "temp_bytes": float(getattr(mem, "temp_size_in_bytes", 0) or 0),
-        "block_rows": float(rows),
-        "flops_per_row": flops / rows if rows else 0.0,
-    }
+# inspection helpers live in utils/inspection.py (re-exported below)
 
 
 def block_to_row(frame: TensorFrame) -> TensorFrame:
@@ -2165,3 +1286,22 @@ def _install_fluent_methods() -> None:
 
 
 _install_fluent_methods()
+
+
+# late import: streaming.py references this module's helpers at call
+# time, so it must load after every definition above
+from .fn_frontend import (  # noqa: E402
+    _assemble_ragged,
+    _empty_fn_outputs,
+    _fn_feed_columns,
+    _fn_outputs_to_dict,
+    _map_blocks_fn,
+    _map_rows_fn,
+    _run_ragged_bucketed,
+)
+from .streaming import _prefetch_iter, reduce_blocks_stream  # noqa: E402
+from .utils.inspection import (  # noqa: E402
+    _lower_for_inspection,
+    cost_analysis,
+    explain_hlo,
+)

@@ -71,6 +71,28 @@ class Config:
     block_retry_attempts: int = 0
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).
     check_numerics: bool = False
+    # Route verbs through the C++ PJRT host (`runtime.native_executor`)
+    # when no explicit executor= is passed — the SURVEY §2.4 framing:
+    # the native host is the libtensorflow-equivalent spine, not an
+    # opt-in. Values:
+    #   "off"  — in-process JAX executor (jaxlib is itself a native
+    #            runtime; this remains the safe default)
+    #   "auto" — use NativeExecutor over the repo-built CPU plugin when
+    #            it is present; silently fall back to in-process JAX
+    #            when it is not. Mesh kinds on the single-device plugin
+    #            fall back to in-process JAX per the documented
+    #            NativeExecutor(jax_fallback=True) semantics (safe: the
+    #            repo CPU plugin claims no shared accelerator device).
+    #   "require" — like "auto" but raise if the plugin is unavailable
+    #            (the CI native lane uses this so silent fallback can
+    #            never mask a broken build).
+    # Env override TFS_NATIVE_EXECUTOR seeds the initial value so a CI
+    # lane can run the whole verb suite under the native default.
+    native_executor: str = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "TFS_NATIVE_EXECUTOR", "off"
+        )
+    )
 
     def lax_precision(self):
         from jax import lax

@@ -1,0 +1,308 @@
+"""Plain-function front-end kernels + ragged bucketed execution.
+
+The TPU-native tracer front-end: verbs accept a plain Python function
+over column arrays (no GraphDef needed) — `_map_blocks_fn` /
+`_map_rows_fn` are their execution kernels, and `_run_ragged_bucketed`
+is the shape-bucketing plan shared by the graph and function per-row
+paths (and, per shard, by `parallel.verbs._ragged_per_shard`).
+Extracted from `api.py` (round-4 verdict task 7); `api.py` re-exports
+every name, so `api._run_ragged_bucketed`-style references and the
+public behavior are unchanged.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from .frame import Column, TensorFrame
+
+from .runtime.executor import Executor  # noqa: F401  (annotations)
+
+# late-bound: api imports this module at its end; helper lookups
+# resolve at call time through the module object
+from . import api as _api
+
+
+def _empty_fn_outputs(jfn, feeds: List) -> Dict[str, np.ndarray]:
+    """Zero-row outputs for a function-front-end verb over an all-empty
+    frame: trace the jitted fn on zero-row feeds (shape-level only). The
+    lead dim is forced to 0 — a trimmed reduction traced on a zero-row
+    block can still report a nonzero lead (e.g. keepdims sums)."""
+    shapes = jax.eval_shape(jfn, *feeds)
+    return {
+        n: np.zeros((0,) + s.shape[1:], s.dtype) for n, s in shapes.items()
+    }
+
+
+def _fn_feed_columns(
+    fn: Callable, frame: TensorFrame, bound: Optional[set] = None
+) -> List[str]:
+    params = [
+        p.name
+        for p in inspect.signature(fn).parameters.values()
+        if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+    ]
+    missing = [
+        p for p in params if p not in frame.info and p not in (bound or ())
+    ]
+    if missing:
+        raise ValueError(
+            f"function front-end: parameters {missing} have no matching "
+            f"columns (columns: {frame.columns})"
+        )
+    return params
+
+
+def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
+    if isinstance(res, dict):
+        return res
+    raise ValueError(
+        f"{what}: a function graph must return a dict of named output "
+        "arrays (output names become column names)"
+    )
+
+
+def _map_blocks_fn(
+    fn: Callable,
+    frame: TensorFrame,
+    trim: bool,
+    ex: Executor,
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
+) -> TensorFrame:
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
+    params = _fn_feed_columns(fn, frame, bound=set(bindings))
+    unknown = sorted(set(bindings) - set(params))
+    if unknown:
+        raise ValueError(
+            f"bindings {unknown} do not match any function parameter "
+            f"(parameters: {params})"
+        )
+    _api._require_dense(frame, [p for p in params if p not in bindings], "map_blocks")
+    # ex.jit, not jax.jit: under the native default this compiles
+    # through the C++ PJRT host like the graph front-end does
+    jfn = ex.jit(lambda *args: _fn_outputs_to_dict(fn(*args), "map_blocks"))
+    acc: Dict[str, List[np.ndarray]] = {}
+    out_sizes: List[int] = []
+    for bi in range(frame.num_blocks):
+        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+        if lo == hi:
+            out_sizes.append(0)
+            continue
+        outs = jfn(
+            *[
+                bindings[p] if p in bindings else frame.column(p).values[lo:hi]
+                for p in params
+            ]
+        )
+        bsize = None
+        for name, o in outs.items():
+            if o.ndim == 0:
+                raise ValueError(
+                    f"map_blocks: output {name!r} must have a lead (row) dim"
+                    + ("" if trim else "; use trim=True for reductions")
+                )
+            if not trim and o.shape[0] != hi - lo:
+                raise ValueError(
+                    f"map_blocks: output {name!r} does not preserve the "
+                    "block row count; use trim=True"
+                )
+            if trim:
+                if bsize is None:
+                    bsize = o.shape[0]
+                elif o.shape[0] != bsize:
+                    raise ValueError(
+                        "map_blocks(trim): outputs disagree on row count"
+                    )
+            acc.setdefault(name, []).append(o)
+        out_sizes.append(bsize if trim else hi - lo)
+    if not acc:  # every block empty: zero-row outputs, names from a trace
+        empties = _empty_fn_outputs(
+            jfn,
+            [
+                bindings[p] if p in bindings else frame.column(p).values[:0]
+                for p in params
+            ],
+        )
+        acc = {n: [v] for n, v in empties.items()}
+    out_cols = [Column(n, _api._concat_parts(parts)) for n, parts in acc.items()]
+    offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
+    return _api._output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
+
+
+def _run_ragged_bucketed(
+    vfn,
+    columns: List[Column],
+    nrows: int,
+    out_names_hint: Optional[List[str]] = None,
+    defer: bool = False,
+) -> Dict[str, List[np.ndarray]]:
+    """Shape-bucketed execution for ragged rows: group rows by their joint
+    cell-shape signature, run ONE vmapped XLA call per bucket, scatter the
+    results back in row order.
+
+    This is the shape-bucketing plan of SURVEY §7 "hard parts" — the ragged
+    analogue of the reference's per-row variable-length support
+    (`TFDataOps.scala:90-103`) without its one-session.run-per-row cost.
+    Bucket sizes are padded to the next power of two (duplicating the last
+    row; padded outputs discarded) so the compile count is bounded by
+    O(#distinct cell shapes x log max bucket) instead of O(#rows).
+
+    ``vfn`` is a vmapped callable returning either a tuple (graph path,
+    ``out_names_hint`` gives the names) or a dict (function front-end).
+    Returns name -> list of per-row output cells (row order).
+
+    ``defer=True`` returns the raw chunk pairs (name -> [(row indices,
+    DEVICE array)]) without assembling: the mesh ragged path
+    (`parallel.verbs._ragged_per_shard`) runs this once per device and
+    must not block on device-to-host transfer between shards — it
+    collects every shard's chunks and assembles once at the end via
+    `_assemble_ragged`.
+    """
+    cells = [c.values if c.is_dense else c.ragged for c in columns]
+    buckets: Dict[Tuple, List[int]] = {}
+    for i in range(nrows):
+        key = tuple(cc[i].shape for cc in cells)
+        buckets.setdefault(key, []).append(i)
+
+    # (idxs, chunk) pairs per output name; assembled dense below when all
+    # buckets agree on the output cell shape, else per-row (ragged result)
+    chunks: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {}
+    for idxs in buckets.values():
+        nb = len(idxs)
+        padded = 1 << (nb - 1).bit_length()
+        take = idxs + [idxs[-1]] * (padded - nb)
+        feeds = [
+            cc[np.asarray(take)]
+            if col.is_dense
+            else np.stack([cc[i] for i in take])
+            for col, cc in zip(columns, cells)
+        ]
+        outs = vfn(*feeds)
+        if not isinstance(outs, dict):
+            outs = dict(zip(out_names_hint, outs))
+        idx_arr = np.asarray(idxs)
+        for name, o in outs.items():
+            # keep the DEVICE array (slicing is lazy): converting here
+            # would block on transfer before the next bucket dispatches,
+            # serializing the whole plan — with per-shard device
+            # placement (parallel.verbs._ragged_per_shard) every
+            # device's buckets must be in flight before any fetch
+            chunks.setdefault(name, []).append((idx_arr, o[:nb]))
+
+    if defer:
+        return chunks
+    return _assemble_ragged(chunks, nrows)
+
+
+def _assemble_ragged(
+    chunks: Dict[str, List[Tuple[np.ndarray, "jax.Array"]]], nrows: int
+) -> Dict[str, Union[np.ndarray, List[np.ndarray]]]:
+    """Scatter bucketed chunk outputs back into row order. Device->host
+    conversion happens HERE, after every bucket (and, for the mesh path,
+    every shard's device) has been dispatched."""
+    per_row: Dict[str, Union[np.ndarray, List[np.ndarray]]] = {}
+    for name, pairs in chunks.items():
+        cell_shapes = {o.shape[1:] for _, o in pairs}
+        if len(cell_shapes) == 1:  # uniform outputs: one dense scatter
+            shape = next(iter(cell_shapes))
+            res = np.empty((nrows,) + shape, dtype=pairs[0][1].dtype)
+            for idx_arr, o in pairs:
+                res[idx_arr] = np.asarray(o)
+            per_row[name] = res
+        else:
+            rows: List[Optional[np.ndarray]] = [None] * nrows
+            for idx_arr, o in pairs:
+                o = np.asarray(o)
+                for j, i in enumerate(idx_arr):
+                    rows[i] = o[j]
+            per_row[name] = rows
+    return per_row
+
+
+def _map_rows_fn(
+    fn: Callable,
+    frame: TensorFrame,
+    ex: "Executor",
+    bindings: Optional[Dict[str, "np.ndarray"]] = None,
+) -> TensorFrame:
+    """Function front-end for map_rows: fn(cell, ...) -> dict of outputs.
+
+    jit/vmap preserve dict outputs, so output names come from the traced
+    dict directly — the user function is invoked exactly once per trace.
+    ``bindings`` match function PARAMETER names and are held constant
+    across rows (vmap in_axes=None), like the graph front-end.
+    """
+    bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
+    params = _fn_feed_columns(fn, frame, bound=set(bindings))
+    unknown = sorted(set(bindings) - set(params))
+    if unknown:
+        raise ValueError(
+            f"bindings {unknown} do not match any function parameter "
+            f"(parameters: {params})"
+        )
+    col_params = [p for p in params if p not in bindings]
+    if bindings and not col_params:
+        raise ValueError(
+            "map_rows: every parameter is bound, so nothing varies per "
+            "row; use map_blocks (or call the function directly)"
+        )
+    dense = all(frame.column(p).is_dense for p in col_params)
+    if bindings and not dense:
+        raise ValueError(
+            "map_rows: bindings are not supported with ragged feed "
+            "columns; densify the columns or bake the values as constants"
+        )
+
+    def wrapped(*cells):
+        return _fn_outputs_to_dict(fn(*cells), "map_rows")
+
+    def _feeds(lo, hi):
+        return [
+            bindings[p] if p in bindings else frame.column(p).values[lo:hi]
+            for p in params
+        ]
+
+    acc: Dict[str, List[np.ndarray]] = {}
+    if dense:
+        in_axes = tuple(None if p in bindings else 0 for p in params)
+        vfn = ex.jit(jax.vmap(wrapped, in_axes=in_axes))
+        for bi in range(frame.num_blocks):
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            if lo == hi:
+                continue
+            outs = vfn(*_feeds(lo, hi))
+            for n, o in outs.items():
+                acc.setdefault(n, []).append(o)
+        if not acc:
+            empties = _empty_fn_outputs(vfn, _feeds(0, 0))
+            acc = {n: [v] for n, v in empties.items()}
+        out_cols = [Column(n, _api._concat_parts(parts)) for n, parts in acc.items()]
+    else:
+        vfn = ex.jit(jax.vmap(wrapped))
+        if frame.nrows == 0:
+            # 0-row ragged columns: synthesize zero-row feeds from the
+            # declared cell shapes (unknown dims collapse to 0)
+            feeds = [
+                np.zeros(
+                    (0,)
+                    + tuple(
+                        0 if d is None else d
+                        for d in frame.column(p).cell_shape.dims
+                    ),
+                    dtype=frame.column(p).dtype.np_dtype,
+                )
+                for p in params
+            ]
+            per_out = {n: v for n, v in _empty_fn_outputs(vfn, feeds).items()}
+        else:
+            per_out = _run_ragged_bucketed(
+                vfn, [frame.column(p) for p in params], frame.nrows
+            )
+        out_cols = [Column(n, vals) for n, vals in per_out.items()]
+    return _api._output_frame(frame, out_cols, append_input=True)
+
+

@@ -788,10 +788,13 @@ def _extract_cond(
             "Merge — unstructured control flow"
         )
 
-    # captures: external data edges consumed inside either branch
+    # captures: external data edges consumed inside either branch.
+    # Iterate the dict (insertion-ordered), NOT a set: cap order decides
+    # the _Cond input order and the content-hashed subgraph keys, which
+    # must be deterministic across processes (hash randomization).
     interior = set(labels)
     cap_edges: List[Tuple[str, int]] = []
-    for name in interior:
+    for name in labels:
         for e in g[name].inputs:
             dep, idx, ctrl = parse_edge(e)
             if ctrl or dep in interior or dep in switch_names:

@@ -48,6 +48,12 @@ def parse_edge(edge: str) -> Tuple[str, int, bool]:
     return edge, 0, False
 
 
+def base_name(edge: str) -> str:
+    """Node name of an edge (strips ``:k`` / ``^``) — the shared `_base`
+    helper every verb/planner module aliases."""
+    return parse_edge(edge)[0]
+
+
 @dataclass
 class GraphNode:
     name: str

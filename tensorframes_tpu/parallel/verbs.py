@@ -40,7 +40,7 @@ from jax import shard_map
 from ..frame import Column, TensorFrame
 from ..graph import builder as dsl
 from ..graph.analysis import analyze_graph
-from ..graph.ir import Graph, parse_edge
+from ..graph.ir import Graph, base_name, parse_edge
 from ..ops.lowering import build_callable
 from .. import api as _api
 from ..runtime.executor import Executor, default_executor, lru_get_or_insert
@@ -55,8 +55,7 @@ __all__ = [
 ]
 
 
-def _base(name: str) -> str:
-    return parse_edge(name)[0]
+_base = base_name
 
 
 @lru_cache(maxsize=64)

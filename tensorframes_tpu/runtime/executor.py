@@ -52,6 +52,13 @@ class Executor:
         self._lock = threading.Lock()
         self.compile_count = 0  # observability: distinct lowered callables
 
+    def jit(self, fn: Callable) -> Callable:
+        """Compile an arbitrary jittable for this executor's runtime.
+        The function front-end kernels route through this seam so the
+        native executor (which overrides it) runs them on the C++ PJRT
+        host instead of in-process JAX."""
+        return jax.jit(fn)
+
     def cached(
         self,
         kind: str,
@@ -112,9 +119,70 @@ class Executor:
 
 
 _default: Optional[Executor] = None
+_native_default: Optional[object] = None
+_native_unavailable: Optional[str] = None
+_native_lock = threading.Lock()
+
+
+def _native_default_executor():
+    """Lazy process-wide NativeExecutor over the repo CPU plugin, or
+    None with the reason recorded. jax_fallback=True is safe HERE
+    because the repo CPU plugin claims no shared accelerator device
+    (`pjrt_host.cpu_plugin_path` docstring) — mesh kinds on this
+    single-device plugin fall back to the in-process JAX executor."""
+    global _native_default, _native_unavailable
+    # lock-free fast path: after initialization every verb dispatch
+    # reads one attribute instead of serializing on the process lock
+    if _native_default is not None:
+        return _native_default
+    if _native_unavailable is not None:
+        return None
+    with _native_lock:
+        if _native_default is not None:
+            return _native_default
+        if _native_unavailable is not None:
+            return None
+        try:
+            from .native_executor import NativeExecutor
+            from .pjrt_host import cpu_plugin_path
+
+            path = cpu_plugin_path()
+            if path is None:
+                _native_unavailable = (
+                    "native/libtfs_pjrt_cpu.so is not built (make -C native)"
+                )
+                return None
+            _native_default = NativeExecutor(path, jax_fallback=True)
+            return _native_default
+        except Exception as e:  # plugin load/claim failure
+            _native_unavailable = f"plugin load failed: {e}"
+            return None
 
 
 def default_executor() -> Executor:
+    """The executor verbs use when no ``executor=`` is passed. With
+    ``config.native_executor`` = "auto"/"require", single-program kinds
+    route through the C++ PJRT host (`NativeExecutor`) — the
+    libtensorflow-equivalent spine as the default, not an opt-in."""
+    from .. import config as _config
+
+    mode = _config.get().native_executor
+    if mode not in ("off", "auto", "require"):
+        # fail loud: a typo'd mode silently meaning "off" would defeat
+        # exactly the guarantee "require" exists to provide
+        raise ValueError(
+            f"config.native_executor={mode!r} is not one of "
+            "'off' | 'auto' | 'require'"
+        )
+    if mode in ("auto", "require"):
+        ex = _native_default_executor()
+        if ex is not None:
+            return ex  # type: ignore[return-value]
+        if mode == "require":
+            raise RuntimeError(
+                "config.native_executor='require' but the native host is "
+                f"unavailable: {_native_unavailable}"
+            )
     global _default
     if _default is None:
         _default = Executor()

@@ -1,0 +1,171 @@
+"""Out-of-core streaming reduce (extracted from `api.py`).
+
+`reduce_blocks_stream` folds an iterator of frames with background
+prefetch and bounded-memory tree-folding — the Spark-spill analogue
+that makes the BASELINE north star (1B-row vector reduce) run in
+bounded host memory. `api.py` re-exports both names, so the public
+surface is unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .aggregate import _chunk_combiners
+from .frame import TensorFrame
+from .graph.analysis import analyze_graph
+from .graph.ir import base_name as _base
+from .runtime.executor import Executor
+
+# late-bound: api imports this module, so helper lookups resolve at
+# call time through the module object (same pattern as parallel/verbs)
+from . import api as _api
+
+from .api import Fetches  # noqa: E402,F401  (annotations; api is mid-init
+# but Fetches is defined before this module loads)
+
+
+def _prefetch_iter(it, depth: int = 1):
+    """Pull ``it`` on a daemon thread, ``depth`` items ahead. The consumer
+    (device execution) and the producer (chunk synthesis / host IO) then
+    overlap — the streaming analogue of Spark's pipelined partition fetch."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    _END = object()
+    cancelled = threading.Event()
+
+    def _put(msg) -> bool:
+        # Bounded put that gives up when the consumer abandoned the
+        # generator — otherwise the producer thread would block forever
+        # on the full queue, pinning the buffered chunks in memory.
+        while not cancelled.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in it:
+                if not _put(("item", item)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
+            _put(("error", e))
+            return
+        _put(("end", _END))
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "error":
+                raise payload
+            if kind == "end":
+                return
+            yield payload
+    finally:
+        cancelled.set()
+        while not q.empty():  # release buffered chunks promptly
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+
+
+def reduce_blocks_stream(
+    fetches: Fetches,
+    frames,
+    feed_dict: Optional[Dict[str, str]] = None,
+    fetch_names: Optional[Sequence[str]] = None,
+    executor: Optional[Executor] = None,
+    mesh=None,
+    fold_every="auto",
+):
+    """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
+    hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
+    background prefetch thread while chunk N reduces on device, so host
+    synthesis/IO overlaps device execution; partials combine with the
+    same graph.
+
+    The partial table itself is tree-folded every ``fold_every`` chunks,
+    so host memory is bounded by O(fold_every) partials no matter how
+    long the stream — the streaming form is what makes the BASELINE
+    north star (1B-row vector reduce_sum) run in bounded host memory
+    unconditionally.
+
+    Combining partials through the same graph assumes the reduce is
+    ASSOCIATIVE over blocks (sum/min/max/...) — the same contract as the
+    reference's pairwise partial combine (`reducePairBlock`,
+    `DebugRowOps.scala:748-757`). A non-associative graph (e.g. Mean:
+    a fold result re-enters the next combine weighted as ONE chunk) is
+    not exact under tree-folding, so the default ``fold_every="auto"``
+    enables tree-folding (every 64 chunks) ONLY when every fetch is an
+    associative monoid reduce (sum/min/max/prod) consuming its
+    placeholder DIRECTLY — partials recombine through the same graph,
+    so any transform between placeholder and reduce (``Sum(x*x)``)
+    would be re-applied to the partials at each fold. Mean,
+    transform-then-reduce, and unclassifiable graphs fall back to the
+    single equally-weighted final combine at the cost of O(#chunks)
+    host memory. Pass an int to force a fold cadence, or ``None`` to
+    force the single final combine.
+    """
+    graph, fetch_list = _api._as_graph(fetches, fetch_names)
+    auto_fold = fold_every == "auto"
+    if auto_fold:
+        fold_every = None  # resolved from the first chunk's analysis below
+    if fold_every is not None:
+        fold_every = max(2, int(fold_every))
+
+    def _combine(parts: List[Dict]) -> Dict:
+        stacked = TensorFrame.from_dict(
+            {
+                b: np.stack([np.asarray(p[b]) for p in parts])
+                for b in parts[0]
+            }
+        )
+        r = _api.reduce_blocks(
+            graph, stacked, None, fetch_names=fetch_list, executor=executor
+        )
+        return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+
+    partials: List[Dict] = []
+    for f in _prefetch_iter(frames):
+        if auto_fold:
+            # classify once, on the first chunk: tree-fold only graphs
+            # proven associative (sum/min/max/prod monoids); anything
+            # else keeps every partial for one exact final combine
+            auto_fold = False
+            try:
+                ov = _api._ph_overrides(graph, f, feed_dict, block_level=True)
+                s = analyze_graph(graph, fetch_list, placeholder_shapes=ov)
+                # require_direct: partials recombine through the same
+                # graph here, so an interposed transform (Sum(x*x))
+                # would be re-applied at every fold
+                comb = _chunk_combiners(
+                    graph, fetch_list, s, require_direct=True
+                )
+                if comb is not None and "mean" not in comb.values():
+                    fold_every = 64
+            except Exception:
+                pass  # conservative: no folding when classification fails
+        r = _api.reduce_blocks(
+            graph, f, feed_dict, fetch_names=fetch_list,
+            executor=executor, mesh=mesh,
+        )
+        partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
+        if fold_every is not None and len(partials) >= fold_every:
+            partials = [_combine(partials)]
+    if not partials:
+        raise ValueError("reduce_blocks_stream over an empty iterator")
+    out = partials[0] if len(partials) == 1 else _combine(partials)
+    if len(fetch_list) == 1:
+        return out[_base(fetch_list[0])]
+    return out
+
+

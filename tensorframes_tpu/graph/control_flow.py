@@ -138,7 +138,11 @@ def _attach_sub(g: Graph, kind: str, sub: Subgraph) -> str:
 
 def _placeholder(name: str, dtype=None) -> GraphNode:
     attrs = {}
-    if dtype is not None:
+    # only attach dtypes this schema models: a DT_VARIANT Enter (a
+    # TensorList carried through a Keras RNN loop) parses as raw bytes,
+    # which must not be wrapped in a type attr (the subgraph would no
+    # longer serialize for its content-hash key)
+    if dtype is not None and hasattr(dtype, "tf_datatype"):
         attrs["dtype"] = AttrValue.of_type(dtype)
     return GraphNode(name, "Placeholder", [], attrs)
 
@@ -154,8 +158,12 @@ def _unique_name(g: Graph, base: str) -> str:
 
 def _prune(g: Graph, fetches: Sequence[str]) -> Graph:
     """Drop nodes unreachable from the fetches (the leftover interiors
-    of extracted loops/conds), keeping placeholders (feed_dict may name
-    them) and preserving definition order."""
+    of extracted loops/conds), preserving definition order. Placeholders
+    are kept when CONSUMED by any kept node (feed_dict may rename them)
+    — but not when fully dangling: `convert_variables_to_constants`
+    leaves zero-consumer `unused_control_flow_input*` placeholders
+    behind in frozen RNN graphs, and shape analysis must not demand
+    shapes for those."""
     keep: Set[str] = set()
 
     def visit(name: str):
@@ -167,8 +175,14 @@ def _prune(g: Graph, fetches: Sequence[str]) -> Graph:
 
     for f in fetches:
         visit(parse_edge(f)[0])
+    consumed = {
+        parse_edge(e)[0]
+        for n in g.nodes
+        if n.name in keep
+        for e in n.inputs
+    }
     for n in g.nodes:
-        if n.op in ("Placeholder", "PlaceholderV2"):
+        if n.op in ("Placeholder", "PlaceholderV2") and n.name in consumed:
             visit(n.name)
     out = Graph()
     out.library = g.library

@@ -51,6 +51,64 @@ def _cond(ctx, node, inputs):
     return tuple(out)
 
 
+# ---------------------------------------------------------------------------
+# TensorList ops — what Keras RNN layers (LSTM/GRU) put inside their
+# while loops. Represented as DENSE (num_elements, *element_shape)
+# arrays: XLA has no variant type, and a statically-sized array carry is
+# exactly what lax.while_loop needs. Static bound: element_shape and
+# num_elements must be compile-time constants (they are in frozen Keras
+# graphs — both derive from Shape-of chains this lowering keeps static).
+# ---------------------------------------------------------------------------
+
+
+@register("TensorListReserve")
+def _tl_reserve(ctx, node, inputs):
+    import numpy as np
+
+    eshape = ctx.static_int_list(inputs[0], node, "element_shape")
+    n = int(ctx.static(inputs[1], node, "num_elements"))
+    if any(d < 0 for d in eshape) or n < 0:
+        raise GraphLoweringError(
+            f"TensorListReserve (node {node.name!r}) has dynamic "
+            f"element_shape {eshape} / num_elements {n}; XLA needs "
+            "static list extents (frozen Keras RNN graphs satisfy this)"
+        )
+    st = node.attr("element_dtype")
+    dtype = st.np_dtype if hasattr(st, "np_dtype") else np.float32
+    return jnp.zeros((n, *eshape), dtype)
+
+
+@register("TensorListSetItem")
+def _tl_set_item(ctx, node, inputs):
+    lst, idx, item = inputs
+    lst = jnp.asarray(lst)
+    item = jnp.asarray(item).astype(lst.dtype)
+    start = (jnp.asarray(idx, jnp.int32),) + (0,) * item.ndim
+    return lax.dynamic_update_slice(lst, item[None], start)
+
+
+@register("TensorListGetItem")
+def _tl_get_item(ctx, node, inputs):
+    lst, idx = inputs[0], inputs[1]
+    lst = jnp.asarray(lst)
+    start = (jnp.asarray(idx, jnp.int32),) + (0,) * (lst.ndim - 1)
+    return lax.dynamic_slice(lst, start, (1,) + lst.shape[1:])[0]
+
+
+@register("TensorListStack", "TensorListFromTensor")
+def _tl_passthrough(ctx, node, inputs):
+    # the dense representation IS the stacked tensor (FromTensor's
+    # second input is the element_shape hint; Stack's is ignored too)
+    return jnp.asarray(inputs[0])
+
+
+@register("TensorListLength")
+def _tl_length(ctx, node, inputs):
+    import numpy as np
+
+    return np.int32(jnp.asarray(inputs[0]).shape[0])
+
+
 @register("_While")
 def _while(ctx, node, inputs):
     from .lowering import build_callable

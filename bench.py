@@ -14,6 +14,14 @@ JSON line also carries the hardware-bound views the raw rows/s hides:
 Accelerator acquisition is hardened (round-1 weakness: one 120s probe
 then CPU): stale processes still holding the PJRT plugin are reaped
 gracefully, then the probe retries with backoff before falling back.
+
+Timing invariant: verbs dispatch asynchronously and return device
+arrays, so EVERY timed region here must end with
+``jax.block_until_ready`` (or an equivalent materializing
+``np.asarray``) on the region's outputs — a region without one times
+only the enqueue and reports a fake speedup.
+``benchmarks/pipeline_bench.py`` additionally asserts the chained
+map->reduce path performs zero host syncs.
 """
 
 import json

@@ -22,6 +22,8 @@ from benchmarks._util import emit, scaled  # noqa: E402
 
 
 def main():
+    import jax
+
     import tensorframes_tpu as tfs
     from tensorframes_tpu import dsl
 
@@ -47,7 +49,9 @@ def main():
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        total = once()
+        # reduce_blocks returns a DEVICE scalar (async dispatch);
+        # without the sync each iteration would time only the dispatch
+        total = jax.block_until_ready(once())
         times.append(time.perf_counter() - t0)
     assert int(total) == expected
     best = min(times)

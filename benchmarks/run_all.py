@@ -39,6 +39,9 @@ SMOKE_SIZES = {
     "OVERLAP_CHUNK_ROWS": "200000",
     "OVERLAP_CHUNKS": "6",
     "OVERLAP_THROTTLE_MS": "20",
+    "PIPE_ROWS": "100000",
+    "PIPE_BLOCKS": "4",
+    "PIPE_ITERS": "3",
 }
 
 
@@ -50,6 +53,7 @@ def main():
     sys.path.insert(0, os.path.dirname(here))
     for mod in (
         "convert_bench",
+        "pipeline_bench",
         "map_sum_bench",
         "kmeans_bench",
         "map_rows_mlp_bench",

@@ -38,6 +38,8 @@ from _util import scaled
 
 
 def main():
+    import jax
+
     import tensorframes_tpu as tfs
     from tensorframes_tpu import dsl
 
@@ -75,7 +77,11 @@ def main():
             pass
         tp = time.perf_counter() - t0
         t0 = time.perf_counter()
-        total = tfs.reduce_blocks_stream(s, source(throttle))
+        # the stream result is a device scalar (async); sync before
+        # reading the clock or ts would omit the in-flight combine
+        total = jax.block_until_ready(
+            tfs.reduce_blocks_stream(s, source(throttle))
+        )
         ts = time.perf_counter() - t0
         if check:
             want = sum(
@@ -91,8 +97,11 @@ def main():
 
     one = make_chunk(0)
     t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        tfs.reduce_blocks(s, one)
+    # keep every chunk's device scalar and sync them all: the loop now
+    # only DISPATCHES (reduce_blocks is async), so without the final
+    # block t_device would time 32 enqueues, not 32 reductions
+    totals = [tfs.reduce_blocks(s, one) for _ in range(n_chunks)]
+    jax.block_until_ready(totals)
     t_device = time.perf_counter() - t0
 
     t_produce, t_stream = run_variant(check=True)

@@ -87,8 +87,12 @@ def main(rows: int, chunk_rows: int):
 
     # -- end to end: the streamed pipeline over all rows ---------------
     t0 = time.perf_counter()
-    total = tfs.reduce_blocks_stream(
-        wire, chunks(rows, chunk_rows), fetch_names=fetches
+    # the stream result is a device scalar (async dispatch); sync before
+    # reading the clock or dt would omit the in-flight final combine
+    total = jax.block_until_ready(
+        tfs.reduce_blocks_stream(
+            wire, chunks(rows, chunk_rows), fetch_names=fetches
+        )
     )
     dt = time.perf_counter() - t0
 

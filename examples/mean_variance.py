@@ -14,6 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 import time
 
+import jax
 import numpy as np
 
 import tensorframes_tpu as tfs
@@ -44,6 +45,9 @@ def main(rows: int, dim: int, use_mesh: bool):
     sq = dsl.reduce_sum(sq_input, axes=[0]).named("vsq")
     total = tfs.reduce_blocks(s, squared, mesh=mesh)
     total_sq = tfs.reduce_blocks(sq, squared, mesh=mesh)
+    # reduce results are async device scalars; sync before reading the
+    # clock so the wall time covers the compute, not just the dispatch
+    jax.block_until_ready((total, total_sq))
     dt = time.perf_counter() - t0
 
     mean = np.asarray(total) / rows

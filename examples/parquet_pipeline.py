@@ -21,6 +21,7 @@ import json
 import tempfile
 import time
 
+import jax
 import numpy as np
 
 import tensorframes_tpu as tfs
@@ -47,12 +48,17 @@ def main(rows: int):
     ).named("spend")
 
     t0 = time.perf_counter()
-    total = tfs.reduce_blocks_stream(s, tio.stream_parquet(path))
+    # results are async device arrays; sync inside each timed region so
+    # the walls cover compute, not just dispatch
+    total = jax.block_until_ready(
+        tfs.reduce_blocks_stream(s, tio.stream_parquet(path))
+    )
     t_stream = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     full = tio.read_parquet(path)
     per_key = tfs.aggregate(s, tfs.group_by(full, "channel"))
+    jax.block_until_ready(per_key["spend"].values)
     t_agg = time.perf_counter() - t0
 
     got = dict(

@@ -33,6 +33,7 @@ from .api import (
     block_to_row,
     explain,
     cost_analysis,
+    executor_stats,
     explain_hlo,
     explain_detailed,
     group_by,
@@ -67,6 +68,7 @@ __all__ = [
     "block_to_row",
     "explain",
     "cost_analysis",
+    "executor_stats",
     "explain_hlo",
     "explain_detailed",
     "group_by",

@@ -318,7 +318,10 @@ def _aggregate_segment(
     feeds = [frame.column(mapping[n]).values for n in feed_names]
     outs = sfn(gid, counts, *feeds)
     maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
-    results = {b: np.asarray(o) for b, o in zip(bases, outs)}
+    # device-resident output: the per-group table stays where the
+    # segment ops produced it; a chained verb (or host_values) decides
+    # when — and whether — it crosses to the host
+    results = {b: o for b, o in zip(bases, outs)}
     return _keyed_output(key_out, results, bases)
 
 
@@ -410,8 +413,11 @@ def _aggregate_chunked(
         return q
 
     # 2. chunk stage: one batched call per distinct pow2 chunk size;
-    #    results land in a flat per-fetch partial table (group order)
-    partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+    #    results land in a flat per-fetch partial table (group order).
+    #    All chunk-size programs are DISPATCHED before any result is
+    #    host-fetched (async device partials, same discipline as the
+    #    reduce verbs); the scatter into the flat table then drains them.
+    pending = []
     for p in sorted(chunk_starts_by_p, reverse=True):
         starts_list = chunk_starts_by_p[p]
         n_p = len(starts_list)
@@ -421,7 +427,9 @@ def _aggregate_chunked(
         feeds = [col_data[n][row_idx] for n in feed_names]
         outs = run(feeds)
         maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
-        slots = np.asarray(chunk_slots_by_p[p])
+        pending.append((n_p, np.asarray(chunk_slots_by_p[p]), tuple(outs)))
+    partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+    for n_p, slots, outs in pending:
         for b, o in zip(bases, outs):
             o = np.asarray(o)
             if partials[b] is None:

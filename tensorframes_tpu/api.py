@@ -32,6 +32,7 @@ reduces, `DebugRowOps.scala:80-262`).
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -286,6 +287,58 @@ def _ph_overrides(
 # ---------------------------------------------------------------------------
 
 
+_donation_warning_filtered = False
+_donation_filter_lock = threading.Lock()
+
+
+def _quiet_donation_warning() -> None:
+    """Register (once, process-wide) an ignore filter for jax's "Some
+    donated buffers were not usable" warning: a reduce's output is
+    smaller than its stacked partials by construction, so most donated
+    partial buffers are freed for intermediate reuse rather than
+    aliased into the output — exactly the intent, not a bug worth
+    warning about. One-time registration (module-level lock) instead of
+    a per-call ``warnings.catch_warnings`` because the latter mutates
+    and restores process-global filter state and is not thread-safe
+    under concurrent verbs."""
+    global _donation_warning_filtered
+    if _donation_warning_filtered:
+        return
+    import warnings
+
+    with _donation_filter_lock:
+        if not _donation_warning_filtered:
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            _donation_warning_filtered = True
+
+
+def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
+    """One jitted donated combine over all per-block partials — the ONE
+    donation/caching discipline both reduce verbs share.
+
+    The partials arrive as a tuple of per-block fetch tuples of device
+    arrays (never host-fetched); ``build()`` returns the combine
+    function of that parts-pytree (stack on device, re-reduce — the
+    stacking recipe differs between reduce_blocks' re-fed graph and
+    reduce_rows' scan fold, which is why it is a parameter). On
+    executors that support it the partial buffers are DONATED — after
+    the combine they are dead by construction, so XLA reuses their HBM
+    for the stacked intermediate instead of allocating fresh buffers.
+    """
+
+    def make():
+        combine = build()
+        if getattr(ex, "supports_donation", False):
+            _quiet_donation_warning()
+            return jax.jit(combine, donate_argnums=0)
+        return jax.jit(combine)
+
+    cfn = ex.cached(kind, graph, fetch_list, feed_names, make)
+    return tuple(cfn(tuple(partials)))
+
+
 def _concat_parts(parts: List) -> "np.ndarray":
     """Concatenate block outputs, staying on device when the parts are
     device arrays (no host round-trip for device-resident frames)."""
@@ -296,6 +349,20 @@ def _concat_parts(parts: List) -> "np.ndarray":
 
         return jnp.concatenate([jnp.asarray(p) for p in parts])
     return np.concatenate(parts)
+
+
+def _stack_parts(parts: List) -> "np.ndarray":
+    """Stack partials: on device when any is a `jax.Array`, else with
+    host numpy. The host branch matters beyond convenience — for
+    native-executor partials (host numpy), a `jnp.stack` would
+    initialize the in-process JAX backend next to a native host that
+    may own the same device (the double-client hazard `NativeExecutor`
+    documents)."""
+    if any(isinstance(p, jax.Array) for p in parts):
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.asarray(p) for p in parts])
+    return np.stack([np.asarray(p) for p in parts])
 
 
 def _empty_output(summary: GraphSummary, base: str, drop_lead: bool) -> np.ndarray:
@@ -826,6 +893,12 @@ def reduce_blocks(
     all partials into one (num_blocks)-row block and run the same graph
     once. Returns a single array for one fetch, a dict for several
     (`_unpack_row`, `core.py:111-125`).
+
+    Execution is fully async and device-resident: all block dispatches
+    are issued before anything is fetched, partials stay in device
+    memory, and the combine donates their buffers. The result is a
+    device array (`jax.Array` on the in-process executor) — apply
+    ``np.asarray`` (or keep chaining) at the boundary you choose.
     """
     if mesh is not None:
         from .parallel import verbs as _pverbs
@@ -843,7 +916,18 @@ def reduce_blocks(
 
     feed_names = sorted(summary.inputs)
     fn = ex.callable_for(graph, fetch_list, feed_names)
+    # feed_src[j] = fetch whose partial re-feeds feed_names[j] (fetch
+    # order and sorted-feed order differ with several fetches)
+    fetch_of_feed = {_base(f) + "_input": i for i, f in enumerate(fetch_list)}
+    feed_src = [fetch_of_feed[n] for n in feed_names]
 
+    # Dispatch EVERY block before fetching anything: each fn call is an
+    # async dispatch whose partial stays in device memory, so B blocks
+    # queue back-to-back instead of serializing on a per-block
+    # device->host copy (the per-task sync the reference paid in
+    # `DataOps.scala:63-81`). maybe_check_numerics is a no-op unless the
+    # debug mode is on, in which case it deliberately syncs per block to
+    # name the offender.
     partials: List[Tuple] = []
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -851,18 +935,29 @@ def reduce_blocks(
             continue
         outs = fn(*[frame.column(mapping[n]).values[lo:hi] for n in feed_names])
         maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
-        partials.append(tuple(np.asarray(o) for o in outs))
+        partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:
         final = partials[0]
     else:
-        stacked = {
-            _base(f) + "_input": np.stack([p[i] for p in partials])
-            for i, f in enumerate(fetch_list)
-        }
-        final = fn(*[stacked[n] for n in feed_names])
-        final = tuple(np.asarray(o) for o in final)
+        def build_block_combine():
+            import jax.numpy as jnp
+
+            raw = build_callable(graph, fetch_list, feed_names)
+
+            def combine(parts):
+                stacked = [
+                    jnp.stack([p[i] for p in parts]) for i in feed_src
+                ]
+                return raw(*stacked)
+
+            return combine
+
+        final = _combine_partials(
+            ex, "reduce-combine", graph, fetch_list, feed_names,
+            build_block_combine, partials,
+        )
     if len(fetch_list) == 1:
         return final[0]
     return {_base(f): v for f, v in zip(fetch_list, final)}
@@ -953,7 +1048,7 @@ def reduce_rows(
             )
     feed_names = [b + s for b in bases for s in ("_1", "_2")]
 
-    def make_fold():
+    def fold_body():
         pair = build_callable(graph, fetch_list, feed_names)
 
         def fold(cols: Dict[str, "jax.Array"]):
@@ -969,30 +1064,49 @@ def reduce_rows(
             carry, _ = lax.scan(step, carry0, xs)
             return carry
 
-        return jax.jit(fold)
+        return fold
 
-    jfold = ex.cached("fold", graph, fetch_list, feed_names, make_fold)
-    partials: List[Tuple[np.ndarray, ...]] = []
+    jfold = ex.cached(
+        "fold", graph, fetch_list, feed_names, lambda: jax.jit(fold_body())
+    )
+    # async dispatch, device-resident partials: same discipline as
+    # reduce_blocks — every block's fold is in flight before anything
+    # is combined, and nothing is host-fetched on this path at all
+    partials: List[Tuple] = []
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
         if lo == hi:
             continue
         cols = {b: frame.column(mapping[b + "_1"]).values[lo:hi] for b in bases}
         if hi - lo == 1:
-            partials.append(tuple(np.asarray(cols[b][0]) for b in bases))
+            partials.append(tuple(cols[b][0] for b in bases))
         else:
             outs = jfold(cols)
             maybe_check_numerics(bases, outs, f"reduce_rows block {bi}")
-            partials.append(tuple(np.asarray(o) for o in outs))
+            partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
     if len(partials) == 1:
         final = partials[0]
     else:
-        stacked = {
-            b: np.stack([p[i] for p in partials]) for i, b in enumerate(bases)
-        }
-        final = tuple(np.asarray(o) for o in jfold(stacked))
+        def build_fold_combine():
+            import jax.numpy as jnp
+
+            fold = fold_body()
+
+            def combine(parts):
+                cols = {
+                    b: jnp.stack([p[i] for p in parts])
+                    for i, b in enumerate(bases)
+                }
+                return fold(cols)
+
+            return combine
+
+        final = _combine_partials(
+            ex, "fold-combine", graph, fetch_list, feed_names,
+            build_fold_combine, partials,
+        )
     if len(bases) == 1:
         return final[0]
     return dict(zip(bases, final))
@@ -1117,14 +1231,22 @@ def aggregate(
     )
     if combiners is None:
         # exact plan: one vmapped call per distinct size, whole groups —
-        # no associativity assumption, best for regular key distributions
-        out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+        # no associativity assumption, best for regular key distributions.
+        # Two phases: dispatch EVERY per-size program first (partials
+        # stay as device arrays), then scatter into the host result —
+        # the first host fetch happens only after all sizes are in
+        # flight, so per-size device work overlaps instead of
+        # serializing on each size's D2H copy.
+        pending: List[Tuple[np.ndarray, Tuple]] = []
         for size in unique_sizes:
             gids = np.nonzero(counts == size)[0]
             row_idx = starts[gids][:, None] + np.arange(size)[None, :]
             feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
             outs = vraw(*feeds)
             maybe_check_numerics(bases, outs, f"aggregate groups of size {size}")
+            pending.append((gids, tuple(outs)))
+        out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
+        for gids, outs in pending:
             for b, o in zip(bases, outs):
                 o = np.asarray(o)
                 if out_buffers[b] is None:
@@ -1303,5 +1425,6 @@ from .streaming import _prefetch_iter, reduce_blocks_stream  # noqa: E402
 from .utils.inspection import (  # noqa: E402
     _lower_for_inspection,
     cost_analysis,
+    executor_stats,
     explain_hlo,
 )

@@ -47,6 +47,7 @@ class Column:
 
     def __init__(self, name: str, data: ArrayLike, dtype: Optional[ScalarType] = None):
         self.name = name
+        self._host = None  # lazy host_values() cache for device columns
         if (
             isinstance(data, np.ndarray) and data.dtype != object
         ) or _is_device_array(data):
@@ -150,13 +151,27 @@ class Column:
         return iter(self.values) if self.is_dense else iter(self.ragged)  # type: ignore[arg-type]
 
     def host_values(self) -> np.ndarray:
-        """One host array of all cells, for host-side consumers (group
-        keys, pandas export). Dense columns return their array; scalar
+        """One host numpy array of all cells — THE device->host boundary.
+
+        Verbs keep dense columns device-resident end to end; this is the
+        single explicit point where a column crosses to the host (group
+        keys, pandas/Arrow export, user materialization). The copy is
+        lazy and cached: the first call on a device column blocks on the
+        async pipeline and pays one D2H transfer (counted in the
+        ``host_sync`` profiling stat); later calls return the cached
+        array. Host-numpy columns return their array as-is. Scalar
         string/object columns — which never densify because they cannot
         go to device — assemble an object vector (the reference grouped
         by ANY Catalyst column type, so string group keys must work)."""
         if self.is_dense:
-            return self.values
+            if isinstance(self.values, np.ndarray):
+                return self.values
+            if self._host is None:
+                from .utils.profiling import count
+
+                count("host_sync")
+                self._host = np.asarray(self.values)
+            return self._host
         if not self.cell_shape.is_scalar:
             raise ValueError(
                 f"column {self.name!r} is ragged; no single host array"
@@ -193,6 +208,7 @@ class Column:
         c.ragged = self.ragged
         c.dtype = info.dtype
         c.cell_shape = info.cell_shape
+        c._host = self._host  # same buffer, so the host cache carries over
         return c
 
 
@@ -445,6 +461,11 @@ class TensorFrame:
                     from .parallel.mesh import shard_to_mesh
 
                     vals = shard_to_mesh(mesh, np.asarray(c.values))
+                elif isinstance(c.values, jax.Array) and mesh is None:
+                    # already device-resident: a device_put here would
+                    # round-trip D2H (np.asarray blocks) then re-upload
+                    new_cols.append(c)
+                    continue
                 else:
                     vals = jax.device_put(np.asarray(c.values))
                 nc = Column(c.name, vals, c.dtype)
@@ -455,24 +476,50 @@ class TensorFrame:
         return TensorFrame(new_cols, self.offsets)
 
     # ---- export --------------------------------------------------------
+    def host_values(self, name: str) -> np.ndarray:
+        """Host numpy array of one column — `Column.host_values` through
+        the frame: the explicit, cached device->host boundary."""
+        return self.column(name).host_values()
+
+    def to_host(self) -> "TensorFrame":
+        """Materialize every device-resident column to host numpy (one
+        cached D2H copy per column; `to_device`'s inverse). The frame's
+        verbs never call this — chained verbs stay on device until the
+        USER crosses the boundary here or via `host_values`/`to_pandas`/
+        `collect`."""
+        new_cols = []
+        for c in self._cols.values():
+            if c.is_dense and not isinstance(c.values, np.ndarray):
+                nc = Column(c.name, c.host_values(), c.dtype)
+                nc.cell_shape = c.cell_shape
+                new_cols.append(nc)
+            else:
+                new_cols.append(c)
+        return TensorFrame(new_cols, self.offsets)
+
     def to_pandas(self):
         import pandas as pd
 
         data = {}
         for c in self._cols.values():
             if c.is_dense and c.cell_shape.is_scalar:
-                data[c.name] = np.asarray(c.values)
+                data[c.name] = c.host_values()
+            elif c.is_dense:
+                # one cached D2H copy, then host-side row iteration (a
+                # device column would sync once per row otherwise)
+                data[c.name] = [r.tolist() for r in c.host_values()]
             else:
                 data[c.name] = [np.asarray(r).tolist() for r in c.rows()]
         return pd.DataFrame(data)
 
     def collect(self) -> List[Dict[str, np.ndarray]]:
-        # Materialize each dense column once (a device column would
-        # otherwise pay one device->host sync per cell).
+        # Materialize each dense column once through the cached
+        # host_values boundary (a device column would otherwise pay one
+        # device->host sync per cell).
         host: Dict[str, Column] = {}
         for n, c in self._cols.items():
             if c.is_dense and not isinstance(c.values, np.ndarray):
-                host[n] = Column(n, np.asarray(c.values), c.dtype)
+                host[n] = Column(n, c.host_values(), c.dtype)
             else:
                 host[n] = c
         names = self.columns

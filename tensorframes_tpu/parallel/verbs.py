@@ -653,21 +653,25 @@ def reduce_blocks(
             ),
         )
         outs = sharded(*[main[c] for c in cols_used])
-        partials.append(tuple(np.asarray(o) for o in outs))
+        partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
         outs = tfn(*[tail[c] for c in cols_used])
-        partials.append(tuple(np.asarray(o) for o in outs))
+        partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:
-        final = partials[0]
+        final = tuple(partials[0])
     else:
+        # device-resident combine, same discipline as the host path:
+        # in-process partials (jax.Array) stack on device and re-reduce
+        # without a host round-trip; native-executor partials stay on
+        # host (see api._stack_parts on the double-client hazard)
         tfn = ex.callable_for(graph, fetch_list, feed_names)
         stacked = [
-            np.stack([p[i] for p in partials]) for i in feed_src
+            _api._stack_parts([p[i] for p in partials]) for i in feed_src
         ]
-        final = tuple(np.asarray(o) for o in tfn(*stacked))
+        final = tuple(tfn(*stacked))
     maybe_check_numerics(fetch_list, list(final), "reduce_blocks (mesh)")
     if len(fetch_list) == 1:
         return final[0]

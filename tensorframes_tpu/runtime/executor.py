@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -47,10 +47,21 @@ def lru_get_or_insert(cache, lock, key, make, limit):
 
 
 class Executor:
+    # Compiled programs from this executor may carry `donate_argnums`
+    # (the reduce-combine path): the in-process JAX runtime honors
+    # buffer donation. The native host executes lowered modules through
+    # its own buffer protocol, so `NativeExecutor` sets this False and
+    # verbs build non-donating combines for it.
+    supports_donation = True
+
     def __init__(self):
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
         self._lock = threading.Lock()
         self.compile_count = 0  # observability: distinct lowered callables
+        # cache observability (surfaced via utils.inspection.executor_stats):
+        # a recompile storm shows up as misses growing with call count
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def jit(self, fn: Callable) -> Callable:
         """Compile an arbitrary jittable for this executor's runtime.
@@ -81,9 +92,12 @@ class Executor:
             self._cache, self._lock, key, make,
             _config.get().executor_cache_entries,
         )
-        if inserted:
-            with self._lock:  # += is not atomic; keep the count exact
+        with self._lock:  # += is not atomic; keep the counts exact
+            if inserted:
                 self.compile_count += 1
+                self.cache_misses += 1
+            else:
+                self.cache_hits += 1
         return fn
 
     def callable_for(
@@ -107,11 +121,24 @@ class Executor:
         graph: Graph,
         fetches: Sequence[str],
         feeds: Dict[str, np.ndarray],
-    ) -> List[np.ndarray]:
+        materialize: bool = False,
+    ) -> List[Union["jax.Array", np.ndarray]]:
+        """Execute the graph once over ``feeds``.
+
+        Returns DEVICE arrays by default: the call is an async dispatch
+        and results stay in device memory, so chained runs pipeline
+        without a host round-trip (the reference synced every
+        `session.run` to the JVM heap, `DebugRowOps.scala:790-809`).
+        Pass ``materialize=True`` to block and copy results to host
+        numpy — the explicit opt-in boundary, same contract as
+        `Column.host_values`.
+        """
         feed_names = sorted(feeds)
         fn = self.callable_for(graph, fetches, feed_names)
         out = fn(*[feeds[n] for n in feed_names])
-        return [np.asarray(o) for o in out]
+        if materialize:
+            return [np.asarray(o) for o in out]
+        return list(out)
 
     def clear(self) -> None:
         with self._lock:

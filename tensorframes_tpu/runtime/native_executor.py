@@ -73,6 +73,11 @@ class NativeExecutor:
             jax_fallback,
         )
 
+    # The host executes lowered modules through its own buffer protocol;
+    # donation aliasing is not part of that contract, so verbs build
+    # non-donating combine programs for this executor.
+    supports_donation = False
+
     def _bind_host(self, host, jax_fallback: bool = False) -> None:
         """All non-host state in one place (also the seam tests use to
         wrap an existing host without claiming the plugin twice)."""
@@ -80,6 +85,8 @@ class NativeExecutor:
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
         self._lock = threading.Lock()
         self.compile_count = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
         self._allow_jax_fallback = jax_fallback
         self._jax_fallback = None
 
@@ -215,11 +222,16 @@ class NativeExecutor:
         # holds them). `make()` hands back a jax.jit-wrapped program —
         # used purely as a lowering recipe; execution never touches the
         # in-process JAX backend.
-        fn, _ = lru_get_or_insert(
+        fn, inserted = lru_get_or_insert(
             self._cache, self._lock, key,
             lambda: self._native_run(make()),
             _config.get().executor_cache_entries,
         )
+        with self._lock:  # mirror Executor.cached's hit/miss accounting
+            if inserted:
+                self.cache_misses += 1
+            else:
+                self.cache_hits += 1
         return fn
 
     def callable_for(
@@ -235,3 +247,21 @@ class NativeExecutor:
             feed_names,
             lambda: build_callable(graph, list(fetches), list(feed_names)),
         )
+
+    def run(
+        self,
+        graph: Graph,
+        fetches: Sequence[str],
+        feeds: Dict[str, np.ndarray],
+        materialize: bool = False,
+    ):
+        """Mirror of `Executor.run`'s contract. The native host's
+        execute already lands results in host buffers (its D2H is part
+        of the call), so both modes return numpy; ``materialize`` exists
+        so callers can be executor-agnostic about the boundary."""
+        feed_names = sorted(feeds)
+        fn = self.callable_for(graph, fetches, feed_names)
+        out = fn(*[feeds[n] for n in feed_names])
+        if materialize:
+            return [np.asarray(o) for o in out]
+        return list(out)

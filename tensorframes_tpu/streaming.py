@@ -27,43 +27,90 @@ from .api import Fetches  # noqa: E402,F401  (annotations; api is mid-init
 # but Fetches is defined before this module loads)
 
 
-def _prefetch_iter(it, depth: int = 1):
+def _prefetch_iter(it, depth: int = 1, stage=None):
     """Pull ``it`` on a daemon thread, ``depth`` items ahead. The consumer
     (device execution) and the producer (chunk synthesis / host IO) then
-    overlap — the streaming analogue of Spark's pipelined partition fetch."""
+    overlap — the streaming analogue of Spark's pipelined partition fetch.
+
+    ``stage`` (optional) is a per-item transform run on a SECOND
+    pipeline thread between producer and consumer — the device-transfer
+    stage: when it issues `jax.device_put` for chunk k+1, that H2D copy
+    proceeds under chunk k's compute, double-buffering transfer against
+    execution end to end. A stage failure propagates to the consumer
+    like a producer failure. The ``depth`` budget is SHARED across both
+    pipeline queues (raw queue shrinks to 1 when a stage runs), so
+    adding the stage keeps peak buffered chunks at ~depth+3 — streams
+    sized to the documented bound do not silently double their memory."""
     import queue
     import threading
 
-    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
     _END = object()
     cancelled = threading.Event()
 
-    def _put(msg) -> bool:
-        # Bounded put that gives up when the consumer abandoned the
-        # generator — otherwise the producer thread would block forever
-        # on the full queue, pinning the buffered chunks in memory.
-        while not cancelled.is_set():
-            try:
-                q.put(msg, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+    def _make_put(q):
+        def _put(msg) -> bool:
+            # Bounded put that gives up when the consumer abandoned the
+            # generator — otherwise the pipeline threads would block
+            # forever on the full queue, pinning buffered chunks in
+            # memory.
+            while not cancelled.is_set():
+                try:
+                    q.put(msg, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        return _put
+
+    # one buffering budget for the whole pipeline: with a stage, the
+    # raw queue holds a single handoff item and the staged queue gets
+    # the full depth
+    q_raw: "queue.Queue" = queue.Queue(
+        maxsize=1 if stage is not None else max(1, depth)
+    )
+    put_raw = _make_put(q_raw)
 
     def producer():
         try:
             for item in it:
-                if not _put(("item", item)):
+                if not put_raw(("item", item)):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
-            _put(("error", e))
+            put_raw(("error", e))
             return
-        _put(("end", _END))
+        put_raw(("end", _END))
 
     threading.Thread(target=producer, daemon=True).start()
+
+    if stage is None:
+        q_out = q_raw
+    else:
+        q_out = queue.Queue(maxsize=max(1, depth))
+        put_out = _make_put(q_out)
+
+        def stager():
+            while not cancelled.is_set():
+                try:  # bounded get: exit promptly on consumer abandon
+                    kind, payload = q_raw.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if kind == "item":
+                    try:
+                        payload = stage(payload)
+                    except BaseException as e:  # noqa: BLE001 — consumer side
+                        put_out(("error", e))
+                        return
+                if not put_out((kind, payload)):
+                    return
+                if kind != "item":
+                    return
+
+        threading.Thread(target=stager, daemon=True).start()
+
     try:
         while True:
-            kind, payload = q.get()
+            kind, payload = q_out.get()
             if kind == "error":
                 raise payload
             if kind == "end":
@@ -71,11 +118,12 @@ def _prefetch_iter(it, depth: int = 1):
             yield payload
     finally:
         cancelled.set()
-        while not q.empty():  # release buffered chunks promptly
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
+        for q in (q_out, q_raw):
+            while not q.empty():  # release buffered chunks promptly
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
 
 
 def reduce_blocks_stream(
@@ -123,19 +171,61 @@ def reduce_blocks_stream(
         fold_every = max(2, int(fold_every))
 
     def _combine(parts: List[Dict]) -> Dict:
+        # device partials stack on device (one dispatch, no host
+        # round-trip between fold generations); host partials stay host
         stacked = TensorFrame.from_dict(
-            {
-                b: np.stack([np.asarray(p[b]) for p in parts])
-                for b in parts[0]
-            }
+            {b: _api._stack_parts([p[b] for p in parts]) for b in parts[0]}
         )
         r = _api.reduce_blocks(
             graph, stacked, None, fetch_names=fetch_list, executor=executor
         )
         return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
 
+    transfer_warned = [False]
+
+    def _to_device(f):
+        # the transfer stage of the prefetch pipeline: issue the H2D
+        # copy of chunk k+1 while chunk k computes. Only for the
+        # local single-device path — the mesh path owns its own
+        # sharded placement — and only for real frames (tests feed
+        # plain dicts through here). Already-device columns pass
+        # through untouched (to_device skips them).
+        if isinstance(f, TensorFrame):
+            try:
+                return f.to_device()
+            except Exception as e:
+                # fall back to host arrays (the reduce dispatch will
+                # transfer implicitly) — but say so ONCE: a silently
+                # degraded stream would report serial transfer as an
+                # overlap regression with no clue why
+                if not transfer_warned[0]:
+                    transfer_warned[0] = True
+                    from .utils.log import get_logger
+
+                    get_logger("streaming").warning(
+                        "prefetch device-transfer stage disabled for "
+                        "this stream (%s: %s); chunks will transfer "
+                        "synchronously inside each reduce dispatch",
+                        type(e).__name__, e,
+                    )
+                return f
+        return f
+
+    from .runtime.executor import default_executor
+
+    # No transfer stage for the mesh path (it owns its sharded
+    # placement) or a native-host executor (`.host`): device_put would
+    # initialize the in-process JAX backend next to a host that may own
+    # the same device.
+    ex = executor if executor is not None else default_executor()
+    stage = (
+        _to_device
+        if mesh is None and getattr(ex, "host", None) is None
+        else None
+    )
+
     partials: List[Dict] = []
-    for f in _prefetch_iter(frames):
+    for f in _prefetch_iter(frames, stage=stage):
         if auto_fold:
             # classify once, on the first chunk: tree-fold only graphs
             # proven associative (sum/min/max/prod monoids); anything
@@ -161,6 +251,16 @@ def reduce_blocks_stream(
         partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
         if fold_every is not None and len(partials) >= fold_every:
             partials = [_combine(partials)]
+        elif fold_every is None and len(partials) > 1:
+            # no tree-fold will ever drain this list: spill the PREVIOUS
+            # chunk's (already computed) partial to host so unfoldable
+            # streams cost O(#chunks) host RAM — the documented bound —
+            # not device HBM. The newest partial stays on device, so the
+            # current dispatch still overlaps the next chunk's
+            # production/transfer.
+            partials[-2] = {
+                k: np.asarray(v) for k, v in partials[-2].items()
+            }
     if not partials:
         raise ValueError("reduce_blocks_stream over an empty iterator")
     out = partials[0] if len(partials) == 1 else _combine(partials)

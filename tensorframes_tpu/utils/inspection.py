@@ -71,6 +71,25 @@ def explain_hlo(
     return lowered.as_text()
 
 
+def executor_stats(executor=None) -> Dict[str, int]:
+    """Compile-cache observability for an executor (the process default
+    when none is given): ``compile_count`` (distinct lowered programs),
+    ``cache_hits`` / ``cache_misses`` (per `Executor.cached` lookup),
+    and ``cache_entries`` (live LRU size). A recompile storm — shape or
+    graph churn defeating the cache — shows up as misses growing with
+    call count while hits stall; pair with `cost_analysis` to see what
+    each recompile costs."""
+    from ..runtime.executor import default_executor
+
+    ex = executor if executor is not None else default_executor()
+    return {
+        "compile_count": int(getattr(ex, "compile_count", 0)),
+        "cache_hits": int(getattr(ex, "cache_hits", 0)),
+        "cache_misses": int(getattr(ex, "cache_misses", 0)),
+        "cache_entries": len(getattr(ex, "_cache", ())),
+    }
+
+
 def cost_analysis(
     fetches: Fetches,
     frame: TensorFrame,

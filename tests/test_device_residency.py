@@ -1,0 +1,251 @@
+"""Device-resident async execution: chained verbs never touch the host.
+
+The contract under test (the round-1 tentpole): every reduce-style verb
+dispatches ALL blocks before fetching anything, partials stay
+`jax.Array`, the combine donates partial buffers without invalidating
+anything the caller still holds, and the ONLY device->host boundary is
+the explicit `host_values()` / `np.asarray` the user applies.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.runtime.executor import Executor
+from tensorframes_tpu.utils.inspection import executor_stats
+from tensorframes_tpu.utils.profiling import reset_stats, stats
+
+
+class CountingExecutor(Executor):
+    """Executor that journals every compiled-program invocation (kind
+    order), so a test can prove all N block dispatches happen before
+    the combine — and, with the host_sync counter, before any fetch."""
+
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def cached(self, kind, graph, fetches, feed_names, make):
+        fn = super().cached(kind, graph, fetches, feed_names, make)
+
+        def wrapped(*args, **kwargs):
+            self.events.append(kind)
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def _device_frame(n=32.0, num_blocks=4):
+    return tfs.TensorFrame.from_dict(
+        {"x": np.arange(n, dtype=np.float32)}, num_blocks=num_blocks
+    ).to_device()
+
+
+class TestAsyncDispatch:
+    def test_reduce_blocks_dispatches_all_blocks_before_any_fetch(self):
+        ex = CountingExecutor()
+        df = _device_frame(num_blocks=5)
+        x_in = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_in, axes=[0]).named("x")
+        reset_stats()
+        res = tfs.reduce_blocks(s, df, executor=ex)
+        # all 5 block programs ran, then exactly one combine — in order
+        assert ex.events == ["block"] * 5 + ["reduce-combine"]
+        # nothing crossed to the host during the verb...
+        assert stats().get("host_sync", 0) == 0
+        # ...because the result is still a device array
+        assert isinstance(res, jax.Array)
+        assert float(np.asarray(res)) == float(np.arange(32.0).sum())
+
+    def test_reduce_rows_partials_stay_on_device(self):
+        ex = CountingExecutor()
+        df = _device_frame(num_blocks=3)
+        x1 = tfs.row(df, "x", tf_name="x_1")
+        x2 = tfs.row(df, "x", tf_name="x_2")
+        reset_stats()
+        res = tfs.reduce_rows(dsl.add(x1, x2).named("x"), df, executor=ex)
+        assert ex.events == ["fold"] * 3 + ["fold-combine"]
+        assert stats().get("host_sync", 0) == 0
+        assert isinstance(res, jax.Array)
+        assert float(np.asarray(res)) == float(np.arange(32.0).sum())
+
+    def test_single_block_reduce_skips_combine(self):
+        ex = CountingExecutor()
+        df = _device_frame(num_blocks=1)
+        x_in = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_in, axes=[0]).named("x")
+        res = tfs.reduce_blocks(s, df, executor=ex)
+        assert ex.events == ["block"]
+        assert float(np.asarray(res)) == float(np.arange(32.0).sum())
+
+
+class TestDeviceResidency:
+    def test_chained_intermediates_are_jax_arrays(self):
+        df = _device_frame(num_blocks=4)
+        reset_stats()
+        mapped = tfs.map_blocks((tfs.block(df, "x") * 2.0).named("y"), df)
+        assert isinstance(mapped["y"].values, jax.Array)
+        assert not isinstance(mapped["y"].values, np.ndarray)
+        y_in = tfs.block(mapped, "y", tf_name="y_input")
+        res = tfs.reduce_blocks(dsl.reduce_sum(y_in, axes=[0]).named("y"), mapped)
+        assert isinstance(res, jax.Array)
+        # zero device->host transfers between the chained verbs
+        assert stats().get("host_sync", 0) == 0
+        assert float(np.asarray(res)) == 2.0 * np.arange(32.0).sum()
+
+    def test_aggregate_segment_output_stays_on_device(self):
+        df = tfs.TensorFrame.from_dict(
+            {
+                "k": np.array([0, 1, 0, 1], dtype=np.int64),
+                "v": np.arange(4.0, dtype=np.float32),
+            }
+        ).to_device()
+        s = dsl.reduce_sum(
+            tfs.block(df, "v", tf_name="v_input"), axes=[0]
+        ).named("v")
+        out = tfs.aggregate(s, tfs.group_by(df, "k"))
+        assert isinstance(out["v"].values, jax.Array)
+        assert out["v"].values.tolist() == [2.0, 4.0]
+
+    def test_multi_fetch_reduce_keeps_fetch_feed_alignment(self):
+        # fetch order (x, n) vs sorted feed order (n_input, x_input)
+        # differ; the jitted combine must not swap them
+        df = _device_frame(num_blocks=4)
+        x_in = tfs.block(df, "x", tf_name="x_input")
+        n_in = tfs.block(df, "x", tf_name="n_input")
+        s = dsl.reduce_sum(x_in, axes=[0]).named("x")
+        m = dsl.reduce_min(n_in, axes=[0]).named("n")
+        res = tfs.reduce_blocks([s, m], df, feed_dict={"n_input": "x"})
+        assert float(np.asarray(res["x"])) == float(np.arange(32.0).sum())
+        assert float(np.asarray(res["n"])) == 0.0
+
+    def test_stream_reduce_returns_device_scalar(self):
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": np.arange(4.0, dtype=np.float32) + i}
+            )
+            for i in range(3)
+        ]
+        probe = tfs.TensorFrame.from_dict({"x": np.zeros(1, np.float32)})
+        x_in = tfs.block(probe, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_in, axes=[0]).named("x")
+        res = tfs.reduce_blocks_stream(s, iter(chunks))
+        assert isinstance(res, jax.Array)
+        assert float(np.asarray(res)) == sum(
+            float(np.arange(4.0).sum() + 4 * i) for i in range(3)
+        )
+
+
+class TestDonationSafety:
+    def test_combine_donation_spares_still_referenced_buffers(self):
+        # the combine donates PARTIAL buffers only; columns the caller
+        # still holds (the input frame, the mapped intermediate) must
+        # remain readable after the reduce
+        df = _device_frame(num_blocks=4)
+        mapped = tfs.map_blocks((tfs.block(df, "x") * 3.0).named("y"), df)
+        y_in = tfs.block(mapped, "y", tf_name="y_input")
+        res = tfs.reduce_blocks(dsl.reduce_sum(y_in, axes=[0]).named("y"), mapped)
+        assert float(np.asarray(res)) == 3.0 * np.arange(32.0).sum()
+        # both frames' buffers survived the donated combine
+        np.testing.assert_array_equal(
+            np.asarray(mapped["y"].values), np.arange(32.0) * 3.0
+        )
+        np.testing.assert_array_equal(
+            np.asarray(df["x"].values), np.arange(32.0)
+        )
+
+    def test_repeated_reduce_over_same_frame(self):
+        # donation must never consume the FRAME's buffers: the same
+        # frame reduces twice with identical results
+        df = _device_frame(num_blocks=4)
+        x_in = tfs.block(df, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_in, axes=[0]).named("x")
+        first = float(np.asarray(tfs.reduce_blocks(s, df)))
+        second = float(np.asarray(tfs.reduce_blocks(s, df)))
+        assert first == second == float(np.arange(32.0).sum())
+
+
+class TestHostBoundary:
+    def test_host_values_roundtrip_and_cache(self):
+        want = np.arange(16.0, dtype=np.float32)
+        df = tfs.TensorFrame.from_dict({"x": want}).to_device()
+        reset_stats()
+        hv = df["x"].host_values()
+        assert isinstance(hv, np.ndarray)
+        np.testing.assert_array_equal(hv, want)
+        # lazy + cached: one sync, second call returns the same array
+        assert df["x"].host_values() is hv
+        assert stats().get("host_sync", 0) == 1
+        assert df.host_values("x") is hv
+
+    def test_host_numpy_column_is_returned_as_is(self):
+        want = np.arange(8.0)
+        df = tfs.TensorFrame.from_dict({"x": want})
+        reset_stats()
+        assert df["x"].host_values() is df["x"].values
+        assert stats().get("host_sync", 0) == 0
+
+    def test_to_host_materializes_every_device_column(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(8.0), "y": np.arange(8.0) * 2}, num_blocks=2
+        ).to_device()
+        host = df.to_host()
+        for name in ("x", "y"):
+            assert isinstance(host[name].values, np.ndarray)
+        assert host.offsets == df.offsets
+        np.testing.assert_array_equal(host["y"].values, np.arange(8.0) * 2)
+
+    def test_executor_run_device_by_default_host_on_optin(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0, dtype=np.float32)})
+        graph, fetches = dsl.build((tfs.block(df, "x") + 1.0).named("z"))
+        ex = Executor()
+        feeds = {"x": np.arange(4.0, dtype=np.float32)}
+        (dev,) = ex.run(graph, fetches, feeds)
+        assert isinstance(dev, jax.Array)
+        (host,) = ex.run(graph, fetches, feeds, materialize=True)
+        assert isinstance(host, np.ndarray)
+        np.testing.assert_array_equal(host, np.asarray(dev))
+
+
+class TestExecutorCacheCounters:
+    def test_hits_and_misses_count(self):
+        ex = Executor()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0, dtype=np.float32)})
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        after_first = executor_stats(ex)
+        assert after_first["cache_misses"] == after_first["compile_count"] == 1
+        tfs.map_blocks(z, df, executor=ex)
+        after_second = executor_stats(ex)
+        assert after_second["cache_hits"] == after_first["cache_hits"] + 1
+        assert after_second["cache_misses"] == 1
+        assert after_second["cache_entries"] == 1
+
+    def test_stats_surface_defaults_to_process_executor(self):
+        s = executor_stats()
+        assert set(s) == {
+            "compile_count", "cache_hits", "cache_misses", "cache_entries"
+        }
+
+
+class TestCheckNumericsSingleSync:
+    def test_clean_path_passes_and_bad_path_names_fetch(self):
+        from tensorframes_tpu import config
+
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1.0, np.inf], dtype=np.float32)}, num_blocks=1
+        )
+        z = (tfs.block(df, "x") * 1.0).named("z")
+        with config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="'z'"):
+                tfs.map_blocks(z, df)
+            ok = tfs.TensorFrame.from_dict(
+                {"x": np.array([1.0, 2.0], dtype=np.float32)}
+            )
+            out = tfs.map_blocks((tfs.block(ok, "x") * 1.0).named("z"), ok)
+            np.testing.assert_array_equal(
+                np.asarray(out["z"].values), [1.0, 2.0]
+            )

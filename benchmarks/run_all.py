@@ -42,6 +42,9 @@ SMOKE_SIZES = {
     "PIPE_ROWS": "100000",
     "PIPE_BLOCKS": "4",
     "PIPE_ITERS": "3",
+    "FUSE_ROWS": "100000",
+    "FUSE_BLOCKS": "4",
+    "FUSE_ITERS": "3",
 }
 
 
@@ -54,6 +57,7 @@ def main():
     for mod in (
         "convert_bench",
         "pipeline_bench",
+        "fusion_bench",
         "map_sum_bench",
         "kmeans_bench",
         "map_rows_mlp_bench",

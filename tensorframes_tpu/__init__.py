@@ -26,6 +26,7 @@ from .frame import Column, TensorFrame
 from .schema import ColumnInfo, FrameInfo, ScalarType, Shape, Unknown
 from .api import (
     GroupedFrame,
+    LazyFrame,
     aggregate,
     analyze,
     append_shape,
@@ -37,6 +38,7 @@ from .api import (
     explain_hlo,
     explain_detailed,
     group_by,
+    lazy,
     map_blocks,
     map_rows,
     print_schema,
@@ -61,6 +63,8 @@ __all__ = [
     "Shape",
     "Unknown",
     "GroupedFrame",
+    "LazyFrame",
+    "lazy",
     "aggregate",
     "analyze",
     "append_shape",

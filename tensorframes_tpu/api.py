@@ -65,6 +65,8 @@ __all__ = [
     "explain",
     "explain_detailed",
     "block_to_row",
+    "lazy",
+    "LazyFrame",
 ]
 
 Fetches = Union[dsl.Tensor, Sequence[dsl.Tensor], Graph, bytes, str, Callable]
@@ -88,6 +90,13 @@ def _pandas_in_out(verb):
             tf_frame = TensorFrame.from_pandas(frame)
             with record(verb.__name__, tf_frame.nrows):
                 out = verb(fetches, tf_frame, *args, **kwargs)
+            from .lazy import LazyFrame
+
+            if isinstance(out, LazyFrame):
+                # pandas in -> pandas out is the eager debug path; a
+                # lazy() mode active around it must not leak a deferred
+                # plan to a pandas caller
+                out = out.force()
             return out.to_pandas() if isinstance(out, TensorFrame) else out
         rows = frame.nrows if isinstance(frame, TensorFrame) else 0
         with record(verb.__name__, rows):
@@ -525,7 +534,43 @@ def map_blocks(
     device mesh (see `parallel.verbs`). ``bindings`` feeds named
     placeholders a per-call array instead of a column — updates between
     calls do NOT recompile (see `_check_bindings`).
+
+    On a `LazyFrame` — or on a plain frame under ``with tfs.lazy():``
+    with graph fetches (function/``trim``/``bindings`` calls stay
+    eager: they cannot be spliced) — the verb DEFERS: it returns a
+    `LazyFrame` carrying the chain as one pending fused graph; see
+    `tensorframes_tpu.lazy`.
     """
+    from .lazy import LazyFrame, lazy_active
+
+    if isinstance(frame, LazyFrame):
+        return frame.map_blocks(
+            fetches, feed_dict=feed_dict, trim=trim,
+            fetch_names=fetch_names, executor=executor, mesh=mesh,
+            bindings=bindings,
+        )
+    if (
+        lazy_active()
+        and isinstance(frame, TensorFrame)
+        and not trim
+        and not bindings
+        and not (callable(fetches) and not isinstance(fetches, dsl.Tensor))
+    ):
+        from .schema import ScalarType
+
+        lazy_graph, lazy_fetches = _as_graph(fetches, fetch_names)
+        if not any(
+            ph.dtype_attr is ScalarType.string
+            for ph in lazy_graph.placeholders()
+        ):
+            # _fuse_stage directly: the graph is already normalized
+            # (functionalized + frozen), and re-running _as_graph on it
+            # would pay that pass twice per deferred call
+            return LazyFrame(frame, executor=executor, mesh=mesh)._fuse_stage(
+                "map_blocks", lazy_graph, lazy_fetches, feed_dict
+            )
+        # bytes pass-through cannot splice: stay eager under the mode
+        # (the documented contract), falling through to the graph path
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
         if mesh is not None:
             from .parallel import verbs as _pverbs
@@ -690,6 +735,12 @@ def map_rows(
     in_axes=None), the same jit-argument semantics as map_blocks
     bindings.
     """
+    from .lazy import LazyFrame
+
+    if isinstance(frame, LazyFrame):
+        # terminal in effect: force the fused plan (one program per
+        # block), then run the per-row verb on the concrete result
+        frame = frame.force()
     ex = executor or default_executor()
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
@@ -899,7 +950,17 @@ def reduce_blocks(
     memory, and the combine donates their buffers. The result is a
     device array (`jax.Array` on the in-process executor) — apply
     ``np.asarray`` (or keep chaining) at the boundary you choose.
+
+    On a `LazyFrame` this is a TERMINAL action: the reduce's per-block
+    stage fuses into the pending map chain and the whole pipeline runs
+    as ONE program per block (see `tensorframes_tpu.lazy`).
     """
+    from .lazy import LazyFrame
+
+    if isinstance(frame, LazyFrame):
+        return frame.reduce_blocks(
+            fetches, feed_dict, fetch_names, executor, mesh
+        )
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
@@ -1022,7 +1083,15 @@ def reduce_rows(
     into a `lax.scan` and the whole per-block fold is ONE XLA call; block
     partials then fold the same way. Fold order matches the reference
     (left fold in row order), so non-associative graphs agree too.
+
+    On a `LazyFrame` this is a terminal action: the fused plan is
+    forced first (one program per block), then the fold runs on the
+    device-resident result.
     """
+    from .lazy import LazyFrame
+
+    if isinstance(frame, LazyFrame):
+        frame = frame.force()
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
@@ -1121,6 +1190,13 @@ class GroupedFrame:
     """`frame.group_by(keys)` — the RelationalGroupedDataset analogue."""
 
     def __init__(self, frame: TensorFrame, keys: Sequence[str]):
+        from .lazy import LazyFrame
+
+        if isinstance(frame, LazyFrame):
+            # aggregation is a terminal action for a lazy plan: the
+            # fused chain lowers as one program per block here, then
+            # the keyed plans see a concrete device-resident frame
+            frame = frame.force()
         self.frame = frame
         self.keys = list(keys)
         for k in self.keys:
@@ -1300,14 +1376,27 @@ def append_shape(frame: TensorFrame, col: str, shape) -> TensorFrame:
 
 
 def explain(frame: TensorFrame) -> str:
-    """`OperationsInterface.explain` (`DebugRowOps.scala:535-552`)."""
+    """`OperationsInterface.explain` (`DebugRowOps.scala:535-552`).
+
+    For a `LazyFrame`, renders the fused plan with per-stage provenance
+    (deferred verbs, feeds, pending outputs) above the schema."""
+    from .lazy import LazyFrame
+
+    if isinstance(frame, LazyFrame):
+        return frame.explain_plan()
     return frame.info.explain()
 
 
 def explain_detailed(frame: TensorFrame):
     """Structured per-column tensor metadata, the analogue of
     `ExperimentalOperations.explainDetailed` (`ExperimentalOperations.scala:27`):
-    returns the `FrameInfo` itself rather than a rendered string."""
+    returns the `FrameInfo` itself rather than a rendered string. For a
+    `LazyFrame`, returns the structured `LazyPlan` (stages, fused graph,
+    column sources, feeds, virtual schema)."""
+    from .lazy import LazyFrame
+
+    if isinstance(frame, LazyFrame):
+        return frame.plan()
     return frame.info
 
 
@@ -1421,6 +1510,7 @@ from .fn_frontend import (  # noqa: E402
     _map_rows_fn,
     _run_ragged_bucketed,
 )
+from .lazy import LazyFrame, lazy  # noqa: E402
 from .streaming import _prefetch_iter, reduce_blocks_stream  # noqa: E402
 from .utils.inspection import (  # noqa: E402
     _lower_for_inspection,

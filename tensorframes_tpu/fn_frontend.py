@@ -59,6 +59,15 @@ def _fn_feed_columns(
 
 def _fn_outputs_to_dict(res, what: str) -> Dict[str, "jax.Array"]:
     if isinstance(res, dict):
+        if not res:
+            # an empty dict would sail through the per-block loops and
+            # only explode later (e.g. the mesh trim path's np.cumsum
+            # over a None block size); fail at the verb with the cause
+            raise ValueError(
+                f"{what}: the function graph returned an empty dict; it "
+                "must return at least one named output array (output "
+                "names become column names)"
+            )
         return res
     raise ValueError(
         f"{what}: a function graph must return a dict of named output "

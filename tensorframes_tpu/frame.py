@@ -475,6 +475,17 @@ class TensorFrame:
                 new_cols.append(c)
         return TensorFrame(new_cols, self.offsets)
 
+    # ---- lazy plans ----------------------------------------------------
+    def lazy(self) -> "LazyFrame":  # noqa: F821 — forward ref, see lazy.py
+        """Wrap this frame into a `LazyFrame`: subsequent graph-based
+        ``map_blocks`` calls defer and fuse into one XLA program per
+        block, executed at the first terminal action (`collect` /
+        `host_values` / any reduce/aggregate / `.force()`). See
+        `tensorframes_tpu.lazy`."""
+        from .lazy import LazyFrame
+
+        return LazyFrame(self)
+
     # ---- export --------------------------------------------------------
     def host_values(self, name: str) -> np.ndarray:
         """Host numpy array of one column — `Column.host_values` through

@@ -27,10 +27,15 @@ Documented bounds (inherent to compiling, not incidental):
   traces both branches);
 - `Merge` value_index outputs (``:1``) and unstructured Switch/Merge
   patterns raise `GraphLoweringError` with the offending node named;
-- FunctionDef edge syntax ``node:out_arg:index`` is resolved positionally
-  for single-output-arg ops (which covers every op this framework can
-  lower; a multi-output-arg op would mis-index and fail loudly at the
-  missing-edge check).
+- FunctionDef edge syntax ``node:out_arg:index`` resolves named out_args
+  to flat output offsets via the op's output-arg signature
+  (`_OP_OUTPUT_ARGS`: TopK, Unique*, FusedBatchNorm*, ...); ops without
+  a table entry are single-output-arg, where positional resolution is
+  exact. A tabled op with an unknown out_arg raises `GraphLoweringError`
+  instead of silently aliasing output 0;
+- loop/cond interiors consumed from OUTSIDE the extracted construct
+  (anything but an `Exit`/`Merge` output) raise `GraphLoweringError`
+  naming the leaking node, instead of a bare `KeyError` later.
 """
 
 from __future__ import annotations
@@ -287,15 +292,72 @@ def _clone_closure(
 # ---------------------------------------------------------------------------
 
 
+# Flat output-arg layout of the multi-output ops this framework lowers.
+# FunctionDef edges use ``node:out_arg:idx`` syntax where ``out_arg``
+# NAMES an output arg of the node's op; the flat output offset is the
+# arg's position in the op's output signature (every arg below is a
+# single tensor, so position + idx is exact). Single-output ops need no
+# entry: their one out_arg sits at offset 0 and ``idx`` is already flat.
+_FBN_OUTS = (
+    "y", "batch_mean", "batch_variance", "reserve_space_1", "reserve_space_2",
+)
+_OP_OUTPUT_ARGS: Dict[str, Tuple[str, ...]] = {
+    "TopK": ("values", "indices"),
+    "TopKV2": ("values", "indices"),
+    "Unique": ("y", "idx"),
+    "UniqueV2": ("y", "idx"),
+    "UniqueWithCounts": ("y", "idx", "count"),
+    "FusedBatchNorm": _FBN_OUTS,
+    "FusedBatchNormV2": _FBN_OUTS,
+    "FusedBatchNormV3": _FBN_OUTS + ("reserve_space_3",),
+    "MaxPoolWithArgmax": ("output", "argmax"),
+    "Switch": ("output_false", "output_true"),
+    "RefSwitch": ("output_false", "output_true"),
+    "Merge": ("output", "value_index"),
+    "RefMerge": ("output", "value_index"),
+}
+
+
+def _flat_output_index(op: Optional[str], out_arg: str, idx: int, edge: str) -> int:
+    """Resolve a named ``out_arg`` to its flat output offset via the
+    op's output-arg signature. Ops without a table entry are treated as
+    single-output-arg (offset == idx) — correct for every other op this
+    framework lowers; a tabled op with an unrecognized out_arg raises
+    rather than silently resolving to the wrong output."""
+    sig = _OP_OUTPUT_ARGS.get(op or "")
+    if sig is None:
+        return idx
+    if out_arg not in sig:
+        raise GraphLoweringError(
+            f"function body edge {edge!r}: op {op!r} has no output arg "
+            f"{out_arg!r} (outputs: {list(sig)})"
+        )
+    if idx != 0:
+        # every tabled output arg is a single tensor; a nonzero
+        # within-arg index would need list-arg sizing we cannot do here
+        raise GraphLoweringError(
+            f"function body edge {edge!r}: output arg {out_arg!r} of "
+            f"{op!r} is a single tensor but the edge indexes element {idx}"
+        )
+    return sig.index(out_arg)
+
+
 def _fdef_edge(
-    e: str, argmap: Dict[str, str], bodynames: Set[str], prefix: str = ""
+    e: str,
+    argmap: Dict[str, str],
+    bodynames: Set[str],
+    prefix: str = "",
+    body_ops: Optional[Dict[str, str]] = None,
 ) -> str:
     """Translate FunctionDef edge syntax (``arg``, ``node:out_arg:idx``)
     into plain graph edge syntax: args splice to ``argmap`` targets,
     body nodes get ``prefix`` (the call-site name when inlining, empty
     when building a standalone Subgraph). Classification happens BEFORE
     prefixing, so a body node shadowing a caller node name cannot
-    double-prefix."""
+    double-prefix. Named out_args resolve to flat output offsets via the
+    op's output signature (``body_ops``: body node name -> op), so e.g.
+    ``bn:batch_mean:0`` becomes output 1 of a FusedBatchNorm instead of
+    silently aliasing output 0."""
     ctrl = e.startswith("^")
     if ctrl:
         e = e[1:]
@@ -307,12 +369,19 @@ def _fdef_edge(
     if base in bodynames:
         if ctrl:
             return f"^{prefix}{base}"
+        op = (body_ops or {}).get(base)
         if len(parts) == 3:
-            return f"{prefix}{base}:{parts[2]}"
+            if not parts[2].isdigit():
+                raise GraphLoweringError(
+                    f"malformed function body edge {e!r}"
+                )
+            k = _flat_output_index(op, parts[1], int(parts[2]), e)
+            return f"{prefix}{base}:{k}"
         if len(parts) == 2 and parts[1].isdigit():
             return f"{prefix}{base}:{parts[1]}"
         if len(parts) == 2:
-            return f"{prefix}{base}:0"
+            k = _flat_output_index(op, parts[1], 0, e)
+            return f"{prefix}{base}:{k}"
         return f"{prefix}{base}"
     raise GraphLoweringError(
         f"function body edge {e!r} references neither an argument "
@@ -370,9 +439,16 @@ def _inline_calls(g: Graph, fetches: List[str]) -> Tuple[Graph, List[str]]:
             argmap = _call_site_argmap(fdef, node)
             prefix = node.name + "/"
             bodynames = {bn.name for bn in fdef.nodes}
+            body_ops = {bn.name: bn.op for bn in fdef.nodes}
 
-            def tr(e: str, argmap=argmap, bodynames=bodynames, prefix=prefix):
-                return _fdef_edge(e, argmap, bodynames, prefix)
+            def tr(
+                e: str,
+                argmap=argmap,
+                bodynames=bodynames,
+                prefix=prefix,
+                body_ops=body_ops,
+            ):
+                return _fdef_edge(e, argmap, bodynames, prefix, body_ops)
 
             for bn in fdef.nodes:
                 out.add(
@@ -400,12 +476,13 @@ def _fdef_to_subgraph(fdef: FunctionDef) -> Subgraph:
     sub = Graph()
     argmap = {a.name: a.name for a in fdef.input_args}
     bodynames = {bn.name for bn in fdef.nodes}
+    body_ops = {bn.name: bn.op for bn in fdef.nodes}
     for a in fdef.input_args:
         sub.add(_placeholder(a.name, a.type))
     for bn in fdef.nodes:
         inputs = []
         for e in bn.inputs:
-            te = _fdef_edge(e, argmap, bodynames)
+            te = _fdef_edge(e, argmap, bodynames, body_ops=body_ops)
             if not te.startswith("^"):
                 inputs.append(te)
         sub.add(GraphNode(bn.name, bn.op, inputs, dict(bn.attrs)))
@@ -417,7 +494,7 @@ def _fdef_to_subgraph(fdef: FunctionDef) -> Subgraph:
                 f"function {fdef.name!r} has no ret entry for output "
                 f"{oarg.name!r}"
             )
-        fetches.append(_fdef_edge(ret_edge, argmap, bodynames))
+        fetches.append(_fdef_edge(ret_edge, argmap, bodynames, body_ops=body_ops))
     return Subgraph(sub, [a.name for a in fdef.input_args], fetches)
 
 
@@ -724,7 +801,54 @@ def _extract_while(
         for i, v in enumerate(nvars)
         if v.exit is not None
     }
+    _check_interior_leaks(
+        out, fetches, repl, interior, f"while frame {frame!r}"
+    )
     return _apply_repl(out, fetches, repl)
+
+
+def _check_interior_leaks(
+    out: Graph,
+    fetches: Sequence[str],
+    repl: Dict[Tuple[str, int], str],
+    dropped: Set[str],
+    what: str,
+) -> None:
+    """Before an extracted construct's interior nodes vanish, verify no
+    surviving node (or fetch) REACHABLE from the fetches consumes an
+    interior output that is not re-exported through ``repl`` (Exit /
+    Merge outputs). Raising here names the leaking edge and its
+    consumer; without the check the dangling reference surfaces later as
+    a bare `KeyError` deep in toposort. Unreachable consumers are
+    ignored — `_prune` removes them right after extraction, exactly as
+    before."""
+
+    def leak(consumer: str, edge: str) -> None:
+        dep, idx, _ = parse_edge(edge)
+        raise GraphLoweringError(
+            f"{consumer} consumes {dep}:{idx}, an interior node of the "
+            f"extracted {what}; only its functional outputs are visible "
+            "outside — unstructured control flow"
+        )
+
+    seen: Set[str] = set()
+
+    def visit(name: str):
+        if name in seen or name not in out:
+            return
+        seen.add(name)
+        for e in out[name].inputs:
+            dep, idx, _ = parse_edge(e)
+            if dep in dropped and (dep, idx) not in repl:
+                leak(f"node {out[name].name!r}", e)
+            if dep not in dropped:
+                visit(dep)
+
+    for f in fetches:
+        dep, idx, _ = parse_edge(f)
+        if dep in dropped and (dep, idx) not in repl:
+            leak(f"fetch {f!r}", f)
+        visit(dep)
 
 
 def _resolve_pred(g: Graph, edge: str) -> Tuple[str, int]:
@@ -910,4 +1034,8 @@ def _extract_cond(
         )
     )
     repl = {(m.name, 0): f"{cname}:{j}" for j, m in enumerate(joins)}
+    _check_interior_leaks(
+        out, fetches, repl, drop,
+        f"cond diamond at {joins[0].name!r}",
+    )
     return _apply_repl(out, fetches, repl)

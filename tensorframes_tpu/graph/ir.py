@@ -241,6 +241,23 @@ class Graph:
     def to_bytes(self) -> bytes:
         return self.to_graph_def().to_bytes()
 
+    def clone(self) -> "Graph":
+        """Structural copy: fresh `GraphNode`s (input lists and attr
+        dicts copied one level deep) sharing the library / subgraph side
+        tables. The splice machinery (`graph.fuse`) builds fused graphs
+        on top of a clone so the producer plan is never mutated —
+        LazyFrames stay immutable and can branch like frames do."""
+        g = Graph(
+            [
+                GraphNode(n.name, n.op, list(n.inputs), dict(n.attrs))
+                for n in self.nodes
+            ]
+        )
+        g.library = dict(self.library)
+        g._library_proto = self._library_proto
+        g.subgraphs = dict(self.subgraphs)
+        return g
+
     def fingerprint(self) -> str:
         """Stable content hash; the compile-cache key component that replaces
         the reference's per-task graph re-import (`DebugRowOps.scala:790`).

@@ -1,0 +1,631 @@
+"""Lazy execution plans: defer chained verbs, fuse, compile ONE program.
+
+The eager verbs dispatch one jitted program per verb per block, with the
+intermediate columns materialized as device buffers between stages. For
+the common pipeline shape — ``map_blocks -> map_blocks -> reduce_blocks``
+— that is O(verbs) dispatches and O(verbs) full-size intermediates per
+block. A `LazyFrame` instead accumulates the chain as one pending fused
+`Graph` (`graph.fuse.splice`): each deferred ``map_blocks`` splices its
+graph onto the plan by rewiring placeholders to the producer outputs
+whose base name matches their column (the same placeholder<->column
+matching the eager verbs use), with dtype and shape-precision checks at
+splice time. A *terminal action* — ``collect()`` / ``host_values()`` /
+``to_pandas()``, any reduce/aggregate, or an explicit ``.force()`` —
+lowers the whole fused graph through the ordinary `Executor.cached`
+path as ONE XLA program per block (one fused `shard_map` program on the
+mesh path, `parallel.verbs.fused_map_blocks` /
+`fused_reduce_blocks`): intermediates stay in registers/HBM-local,
+dispatch count drops from O(verbs) to O(1) per block, and the executor
+cache keys on the fused graph's fingerprint.
+
+Entry points:
+
+- ``df.lazy()`` — wrap a `TensorFrame` into a `LazyFrame` explicitly;
+- ``with tfs.lazy(): ...`` — a mode under which graph-based
+  ``map_blocks`` calls on plain frames return LazyFrames. Function
+  front-end fetches, ``trim=True``, ``bindings`` and pandas frames stay
+  eager under the mode (they cannot be spliced); on an explicit
+  `LazyFrame`, ``trim``/``bindings`` raise instead so the deferral
+  contract is never silently broken.
+
+Laziness contract: a `LazyFrame` is row-aligned with its base frame
+(same ``nrows``/``offsets``), its schema (`.info`) is the fused plan's
+virtual schema (graph outputs sorted by name, then base passthrough),
+and nothing executes until a terminal action. ``reduce_blocks`` fuses
+the reduce's per-block stage into the pending graph (the combine over
+stacked partials runs the plain reduce graph, exactly like the eager
+verb); ``reduce_rows`` / ``aggregate`` / ``map_rows`` force the plan
+first (one fused program per block), then run eagerly on the
+device-resident result.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .frame import Column, TensorFrame
+from .graph.fuse import splice
+from .graph.ir import Graph, base_name as _base
+from .schema import ColumnInfo, FrameInfo, ScalarType
+
+# late-bound: api imports this module at its end; helper lookups resolve
+# at call time through the module object (same pattern as streaming.py)
+from . import api as _api
+
+__all__ = ["lazy", "lazy_active", "LazyFrame", "LazyStage", "LazyPlan"]
+
+
+_LAZY_MODE: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "tfs_lazy_mode", default=False
+)
+
+
+@contextmanager
+def lazy():
+    """Enable lazy mode for the enclosed block: graph-based ``map_blocks``
+    calls on plain frames return `LazyFrame`s instead of dispatching.
+    Thread-/task-safe via contextvars (same discipline as `dsl.scope`)."""
+    tok = _LAZY_MODE.set(True)
+    try:
+        yield
+    finally:
+        _LAZY_MODE.reset(tok)
+
+
+def lazy_active() -> bool:
+    return _LAZY_MODE.get()
+
+
+@dataclass(frozen=True)
+class LazyStage:
+    """Provenance record for one deferred verb (rendered by explain)."""
+
+    verb: str
+    outputs: Tuple[str, ...]
+    nodes: int  # node count the stage contributed to the fused graph
+
+    def __repr__(self) -> str:
+        outs = ", ".join(self.outputs)
+        return f"{self.verb} -> [{outs}] (+{self.nodes} nodes)"
+
+
+@dataclass
+class LazyPlan:
+    """Structured fused plan, the `explain_detailed` analogue for a
+    `LazyFrame`: per-stage provenance plus the fused graph and its
+    column/feed wiring."""
+
+    stages: List[LazyStage]
+    graph: Graph
+    sources: Dict[str, str] = field(default_factory=dict)  # col -> fused edge
+    feeds: Dict[str, str] = field(default_factory=dict)  # placeholder -> base col
+    info: Optional[FrameInfo] = None
+
+    def __repr__(self) -> str:
+        return (
+            f"LazyPlan({len(self.stages)} stages, {len(self.graph)} nodes, "
+            f"outputs {sorted(self.sources)}, feeds {self.feeds})"
+        )
+
+
+class LazyFrame:
+    """A frame whose columns are a pending fused graph over a base frame.
+
+    Construct via ``TensorFrame.lazy()`` or under ``with tfs.lazy():``.
+    All deferred state is immutable — every fused stage returns a new
+    `LazyFrame`, so plans can branch like frames do.
+    """
+
+    def __init__(
+        self,
+        base: TensorFrame,
+        graph: Optional[Graph] = None,
+        sources: Optional[Dict[str, str]] = None,
+        feed_map: Optional[Dict[str, str]] = None,
+        stages: Optional[List[LazyStage]] = None,
+        executor=None,
+        mesh=None,
+    ):
+        self._base = base
+        self._graph = graph if graph is not None else Graph()
+        self._sources: Dict[str, str] = dict(sources or {})
+        self._feed_map: Dict[str, str] = dict(feed_map or {})
+        self._stages: List[LazyStage] = list(stages or [])
+        self._executor = executor
+        self._mesh = mesh
+        self._forced: Optional[TensorFrame] = None
+
+    # -- frame-shaped surface (row-aligned with the base) ---------------
+    @property
+    def nrows(self) -> int:
+        return self._base.nrows
+
+    @property
+    def num_blocks(self) -> int:
+        return self._base.num_blocks
+
+    @property
+    def offsets(self):
+        return self._base.offsets
+
+    @property
+    def columns(self) -> List[str]:
+        return self.info.names
+
+    def _summary(self):
+        """Block-level analysis of the pending graph (memoized globally
+        by fingerprint in `graph.analysis`)."""
+        if not self._sources:
+            return None
+        from .graph.analysis import analyze_graph
+
+        overrides = {
+            ph: self._base.info[col].block_shape
+            for ph, col in self._feed_map.items()
+        }
+        fetches = [self._sources[c] for c in sorted(self._sources)]
+        return analyze_graph(
+            self._graph, fetches, placeholder_shapes=overrides
+        )
+
+    @property
+    def info(self) -> FrameInfo:
+        """Virtual schema: fused-graph outputs (sorted by name) first,
+        then base passthrough columns — the same ordering as the eager
+        `_output_frame`."""
+        summary = self._summary()
+        if summary is None:
+            return self._base.info
+        cols = []
+        for c in sorted(self._sources):
+            ns = summary.outputs[_base(self._sources[c])]
+            cols.append(ColumnInfo(c, ns.dtype, ns.shape.tail))
+        shadow = set(self._sources)
+        cols += [ci for ci in self._base.info if ci.name not in shadow]
+        return FrameInfo(cols)
+
+    def __repr__(self) -> str:
+        return (
+            f"LazyFrame[{self.nrows} rows x {len(self.info)} cols, "
+            f"{len(self._stages)} pending stage(s), "
+            f"{len(self._graph)} fused nodes]"
+        )
+
+    # -- splicing -------------------------------------------------------
+    def _resolve_placeholders(
+        self, graph: Graph, feed_dict: Optional[Dict[str, str]], what: str
+    ) -> Tuple[Dict[str, str], Dict[str, str]]:
+        """Map each consumer placeholder to either a pending fused
+        output (a splice binding) or a base column (a feed), validating
+        dtype equality and shape precision AT SPLICE TIME — a mismatch
+        surfaces here, on the deferring call, not at trace/force time.
+
+        Returns ``(bindings: placeholder -> fused edge,
+        new_feeds: placeholder -> base column)``."""
+        feed_dict = feed_dict or {}
+        summary = None
+        by_col: Dict[str, str] = {}
+        for p, c in self._feed_map.items():
+            by_col.setdefault(c, p)
+        bindings: Dict[str, str] = {}
+        new_feeds: Dict[str, str] = {}
+        for ph in graph.placeholders():
+            col = feed_dict.get(ph.name, _api._default_column(ph.name, self))
+            if col in self._sources:
+                if summary is None:
+                    summary = self._summary()
+                ns = summary.outputs[_base(self._sources[col])]
+                if ph.dtype_attr is not None and ph.dtype_attr is not ns.dtype:
+                    raise ValueError(
+                        f"{what}: placeholder {ph.name!r} has dtype "
+                        f"{ph.dtype_attr.name} but fused column {col!r} has "
+                        f"dtype {ns.dtype.name} (TF graphs do not promote "
+                        "dtypes)"
+                    )
+                attr = ph.shape_attr
+                if attr is not None and not ns.shape.check_more_precise_than(
+                    attr
+                ):
+                    raise ValueError(
+                        f"{what}: fused column {col!r} with shape {ns.shape} "
+                        f"is not compatible with shape {attr} requested by "
+                        f"placeholder {ph.name!r}"
+                    )
+                bindings[ph.name] = self._sources[col]
+            elif col in self._base.info:
+                info = self._base.info[col]
+                if ph.dtype_attr is not None and ph.dtype_attr is not info.dtype:
+                    raise ValueError(
+                        f"{what}: placeholder {ph.name!r} has dtype "
+                        f"{ph.dtype_attr.name} but column {col!r} has dtype "
+                        f"{info.dtype.name} (TF graphs do not promote dtypes)"
+                    )
+                attr = ph.shape_attr
+                if attr is not None and not info.block_shape.check_more_precise_than(attr):
+                    raise ValueError(
+                        f"{what}: column {col!r} with shape "
+                        f"{info.block_shape} is not compatible with shape "
+                        f"{attr} requested by placeholder {ph.name!r}"
+                    )
+                prev = by_col.get(col)
+                if (
+                    prev is not None
+                    and self._graph[prev].dtype_attr is ph.dtype_attr
+                ):
+                    # a pending stage already feeds this column: share
+                    # the existing placeholder instead of adding another
+                    bindings[ph.name] = prev
+                else:
+                    new_feeds[ph.name] = col
+            else:
+                raise ValueError(
+                    f"{what}: placeholder {ph.name!r} wants column {col!r} "
+                    f"which is not in the lazy frame (columns: "
+                    f"{self.columns}); use feed_dict to rename"
+                )
+        return bindings, new_feeds
+
+    def _fuse_stage(
+        self,
+        verb: str,
+        graph: Graph,
+        fetch_list: List[str],
+        feed_dict: Optional[Dict[str, str]],
+        executor=None,
+        mesh=None,
+    ) -> "LazyFrame":
+        bindings, new_feeds = self._resolve_placeholders(graph, feed_dict, verb)
+        fused, new_fetches, rename = splice(
+            self._graph, graph, bindings, fetch_list
+        )
+        feed_map = dict(self._feed_map)
+        for ph, col in new_feeds.items():
+            feed_map[rename[ph]] = col
+        sources = dict(self._sources)
+        out_bases = []
+        for old, new in zip(fetch_list, new_fetches):
+            sources[_base(old)] = new  # graph output wins on collision
+            out_bases.append(_base(old))
+        stage = LazyStage(verb, tuple(out_bases), len(graph))
+        return LazyFrame(
+            self._base,
+            fused,
+            sources,
+            feed_map,
+            self._stages + [stage],
+            executor if executor is not None else self._executor,
+            mesh if mesh is not None else self._mesh,
+        )
+
+    # -- deferred verbs -------------------------------------------------
+    def map_blocks(
+        self,
+        fetches,
+        feed_dict: Optional[Dict[str, str]] = None,
+        trim: bool = False,
+        fetch_names=None,
+        executor=None,
+        mesh=None,
+        bindings=None,
+    ) -> "LazyFrame":
+        """Defer a row-preserving block map onto the fused plan."""
+        if trim:
+            raise ValueError(
+                "map_blocks(trim=True) is not supported on a LazyFrame: "
+                "trimmed maps change row alignment with the base frame; "
+                "call .force() first"
+            )
+        if bindings:
+            raise ValueError(
+                "map_blocks: bindings are not supported on a LazyFrame; "
+                "bake the values as graph constants or call .force() first"
+            )
+        if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
+            raise ValueError(
+                "LazyFrame.map_blocks needs a graph (DSL tensors, Graph, "
+                "or GraphDef bytes); function front-end graphs cannot be "
+                "spliced — call .force() first"
+            )
+        graph, fetch_list = _api._as_graph(fetches, fetch_names)
+        if any(
+            ph.dtype_attr is ScalarType.string for ph in graph.placeholders()
+        ):
+            raise ValueError(
+                "lazy map_blocks does not support bytes placeholders "
+                "(host-side pass-through cannot fuse); call .force() first"
+            )
+        return self._fuse_stage(
+            "map_blocks", graph, fetch_list, feed_dict, executor, mesh
+        )
+
+    def map_rows(self, fetches, **kw):
+        """Terminal in effect: forces the pending plan, then runs eagerly."""
+        return _api.map_rows(fetches, self.force(), **kw)
+
+    def reduce_blocks(
+        self,
+        fetches,
+        feed_dict: Optional[Dict[str, str]] = None,
+        fetch_names=None,
+        executor=None,
+        mesh=None,
+    ):
+        """Terminal action: fuse the reduce's per-block stage into the
+        pending graph and run the whole chain as ONE program per block
+        (one fused shard_map program with ``mesh=``); the combine over
+        stacked partials runs the plain reduce graph, exactly like the
+        eager verb."""
+        executor = executor if executor is not None else self._executor
+        mesh = mesh if mesh is not None else self._mesh
+        if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
+            return _api.reduce_blocks(
+                fetches, self.force(), feed_dict, fetch_names, executor,
+                mesh=mesh,
+            )
+        if not self._sources:
+            return _api.reduce_blocks(
+                fetches, self._base, feed_dict, fetch_names, executor,
+                mesh=mesh,
+            )
+        from .graph.analysis import analyze_graph
+        from .runtime.executor import default_executor
+        from .runtime.retry import maybe_check_numerics
+        from .utils.profiling import record
+
+        ex = executor or default_executor()
+        rgraph, rfetch = _api._as_graph(fetches, fetch_names)
+        # validate the reduce contract against the VIRTUAL schema (the
+        # same x <-> x_input checks the eager verb runs on a real frame)
+        feed_dict = feed_dict or {}
+        overrides = {}
+        for ph in rgraph.placeholders():
+            col = feed_dict.get(ph.name, _api._default_column(ph.name, self))
+            if col in self.info:
+                shp = self.info[col].block_shape
+                attr = ph.shape_attr
+                if attr is None or shp.check_more_precise_than(attr):
+                    overrides[ph.name] = shp
+        rsummary = analyze_graph(rgraph, rfetch, placeholder_shapes=overrides)
+        _api._validate_reduce_blocks(rsummary, rfetch)
+
+        bindings, new_feeds = self._resolve_placeholders(
+            rgraph, feed_dict, "reduce_blocks"
+        )
+        fused, fused_fetches, rename = splice(
+            self._graph, rgraph, bindings, rfetch
+        )
+        feed_map = dict(self._feed_map)
+        for ph, col in new_feeds.items():
+            feed_map[rename[ph]] = col
+        feed_names = sorted(feed_map)
+        rfeed_names = sorted(rsummary.inputs)
+        # partials arrive in FETCH order; the combine's positional args
+        # are the SORTED reduce feed names (same re-keying as the eager
+        # verb — see api.reduce_blocks on why this cannot be positional)
+        fetch_of_feed = {
+            _base(f) + "_input": i for i, f in enumerate(rfetch)
+        }
+        feed_src = [fetch_of_feed[n] for n in rfeed_names]
+
+        frame = self._base
+        _api._require_dense(
+            frame, [feed_map[n] for n in feed_names], "reduce_blocks"
+        )
+        # distinct profiling key: the module verb's decorator already
+        # records "reduce_blocks" around this call, and fused-vs-eager
+        # dispatch is worth telling apart in stats anyway
+        with record("reduce_blocks.fused", frame.nrows):
+            if mesh is not None:
+                from .parallel import verbs as _pverbs
+
+                final = _pverbs.fused_reduce_blocks(
+                    fused, fused_fetches, feed_map, frame,
+                    rgraph, rfetch, rfeed_names, feed_src, mesh, ex,
+                )
+            else:
+                fn = ex.callable_for(fused, fused_fetches, feed_names)
+                partials: List[Tuple] = []
+                for bi in range(frame.num_blocks):
+                    lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+                    if lo == hi:
+                        continue
+                    outs = fn(
+                        *[
+                            frame.column(feed_map[n]).values[lo:hi]
+                            for n in feed_names
+                        ]
+                    )
+                    maybe_check_numerics(
+                        rfetch, outs, f"reduce_blocks (fused) block {bi}"
+                    )
+                    partials.append(tuple(outs))
+                if not partials:
+                    raise ValueError("reduce_blocks on an empty frame")
+                if len(partials) == 1:
+                    final = partials[0]
+                else:
+                    from .ops.lowering import build_callable
+
+                    def build_block_combine():
+                        import jax.numpy as jnp
+
+                        raw = build_callable(rgraph, rfetch, rfeed_names)
+
+                        def combine(parts):
+                            stacked = [
+                                jnp.stack([p[i] for p in parts])
+                                for i in feed_src
+                            ]
+                            return raw(*stacked)
+
+                        return combine
+
+                    final = _api._combine_partials(
+                        ex, "reduce-combine", rgraph, rfetch, rfeed_names,
+                        build_block_combine, partials,
+                    )
+        if len(rfetch) == 1:
+            return final[0]
+        return {_base(f): v for f, v in zip(rfetch, final)}
+
+    def reduce_rows(self, fetches, **kw):
+        """Terminal: forces the plan (one fused program per block), then
+        runs the eager pairwise fold on the device-resident result."""
+        return _api.reduce_rows(fetches, self.force(), **kw)
+
+    def group_by(self, *keys: str) -> "_api.GroupedFrame":
+        """Terminal: aggregation needs concrete key columns."""
+        return _api.GroupedFrame(self.force(), keys)
+
+    # -- terminal actions ----------------------------------------------
+    def force(self, executor=None, mesh=None) -> TensorFrame:
+        """Lower the whole fused plan as ONE XLA program per block (one
+        fused shard_map program with a mesh) and return the concrete
+        `TensorFrame` (device-resident outputs + base passthrough)."""
+        if not self._sources:
+            return self._base
+        if executor is None and mesh is None and self._forced is not None:
+            return self._forced
+        from .runtime.executor import default_executor
+        from .runtime.retry import maybe_check_numerics
+        from .utils.profiling import record
+
+        ex = executor or self._executor or default_executor()
+        # the memo write-guard below tests the PARAMETERS (an explicit
+        # executor/mesh override is a one-off), so the plan's own mesh
+        # resolves into a separate name
+        use_mesh = mesh if mesh is not None else self._mesh
+        frame = self._base
+        out_names = sorted(self._sources)
+        fetch_edges = [self._sources[c] for c in out_names]
+        feed_names = sorted(self._feed_map)
+        _api._require_dense(
+            frame, [self._feed_map[n] for n in feed_names], "lazy.force"
+        )
+        with record("lazy.force", frame.nrows):
+            if use_mesh is not None and frame.nrows > 0:
+                from .parallel import verbs as _pverbs
+
+                out = _pverbs.fused_map_blocks(
+                    self._graph, frame, use_mesh, self._feed_map,
+                    fetch_edges, out_names, ex,
+                )
+            else:
+                fn = ex.callable_for(self._graph, fetch_edges, feed_names)
+                acc: Dict[str, List] = {n: [] for n in out_names}
+                for bi in range(frame.num_blocks):
+                    lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+                    if lo == hi:
+                        continue
+                    feeds = [
+                        frame.column(self._feed_map[n]).values[lo:hi]
+                        for n in feed_names
+                    ]
+                    outs = fn(*feeds)
+                    maybe_check_numerics(
+                        out_names, outs, f"lazy fused block {bi}"
+                    )
+                    for n, o in zip(out_names, outs):
+                        if o.ndim == 0 or o.shape[0] != hi - lo:
+                            raise ValueError(
+                                f"lazy plan output {n!r} does not preserve "
+                                "the block row count; trimmed/reducing "
+                                "stages cannot be part of a lazy map plan"
+                            )
+                        acc[n].append(o)
+                vinfo = self.info
+                out_cols = []
+                for n in out_names:
+                    parts = acc[n]
+                    if parts:
+                        data = _api._concat_parts(parts)
+                    else:  # all blocks empty: zero-row column from analysis
+                        ci = vinfo[n]
+                        data = np.zeros(
+                            (0,)
+                            + tuple(
+                                0 if d is None else d
+                                for d in ci.cell_shape.dims
+                            ),
+                            dtype=ci.dtype.np_dtype,
+                        )
+                    out_cols.append(Column(n, data))
+                shadow = set(out_names)
+                cols = out_cols + [
+                    frame.column(c)
+                    for c in frame.columns
+                    if c not in shadow
+                ]
+                out = TensorFrame(cols, frame.offsets)
+        if executor is None and mesh is None:
+            self._forced = out
+        return out
+
+    def host_values(self, name: str) -> np.ndarray:
+        return self.force().host_values(name)
+
+    def collect(self):
+        return self.force().collect()
+
+    def to_pandas(self):
+        return self.force().to_pandas()
+
+    def to_host(self) -> TensorFrame:
+        return self.force().to_host()
+
+    def column(self, name: str) -> Column:
+        return self.force().column(name)
+
+    def __getitem__(self, name: str) -> Column:
+        return self.force().column(name)
+
+    # -- non-terminal frame ops -----------------------------------------
+    def to_device(self, mesh=None) -> "LazyFrame":
+        return LazyFrame(
+            self._base.to_device(mesh), self._graph, self._sources,
+            self._feed_map, self._stages, self._executor, self._mesh,
+        )
+
+    def repartition(self, num_blocks: int) -> "LazyFrame":
+        return LazyFrame(
+            self._base.repartition(num_blocks), self._graph, self._sources,
+            self._feed_map, self._stages, self._executor, self._mesh,
+        )
+
+    def analyze(self) -> "LazyFrame":
+        return LazyFrame(
+            self._base.analyze(), self._graph, self._sources,
+            self._feed_map, self._stages, self._executor, self._mesh,
+        )
+
+    def print_schema(self) -> None:
+        print(self.info.explain())
+
+    # -- plan rendering --------------------------------------------------
+    def plan(self) -> LazyPlan:
+        return LazyPlan(
+            list(self._stages), self._graph, dict(self._sources),
+            dict(self._feed_map), self.info,
+        )
+
+    def explain_plan(self) -> str:
+        """The fused plan with per-stage provenance (rendered by
+        `tfs.explain` for LazyFrames)."""
+        lines = [
+            f"LazyFrame plan: {len(self._stages)} fused stage(s), "
+            f"{len(self._graph)} nodes, {len(self._feed_map)} feed(s), "
+            f"{self._base.nrows} rows x {self._base.num_blocks} blocks"
+        ]
+        for i, st in enumerate(self._stages, 1):
+            lines.append(f"  stage {i}: {st!r}")
+        for ph in sorted(self._feed_map):
+            lines.append(f"  feed: {ph} <- column {self._feed_map[ph]!r}")
+        for c in sorted(self._sources):
+            lines.append(f"  pending: {c} = {self._sources[c]}")
+        lines.append(self.info.explain())
+        return "\n".join(lines)

@@ -52,6 +52,8 @@ __all__ = [
     "reduce_blocks",
     "reduce_rows",
     "aggregate",
+    "fused_map_blocks",
+    "fused_reduce_blocks",
 ]
 
 
@@ -585,6 +587,159 @@ def _fn_mesh(
     return _api._output_frame(
         frame, out_cols, append_input=True, offsets=frame.offsets
     )
+
+
+# ---------------------------------------------------------------------------
+# lazy fusion terminals (LazyFrame.force / LazyFrame.reduce_blocks, mesh=)
+# ---------------------------------------------------------------------------
+
+
+def fused_map_blocks(
+    graph: Graph,
+    frame: TensorFrame,
+    mesh: Mesh,
+    feed_map: Dict[str, str],
+    fetch_edges: Sequence[str],
+    out_names: Sequence[str],
+    executor: Optional[Executor] = None,
+) -> TensorFrame:
+    """Force a lazy map plan on the mesh: the ENTIRE fused chain runs as
+    ONE ``shard_map`` program over the ``data`` axis (+ the usual
+    single-device remainder tail) — one dispatch where the eager chain
+    paid one shard_map program per verb with intermediates materialized
+    in HBM between them. ``feed_map`` wires fused-graph placeholders to
+    base-frame columns; ``fetch_edges``/``out_names`` are the pending
+    fused edges and their output column names (aligned)."""
+    ex = executor or default_executor()
+    feed_names = sorted(feed_map)
+    cols_used = [feed_map[n] for n in feed_names]
+    _api._require_dense(frame, cols_used, "lazy.force")
+    ndev = mesh.devices.size
+    main, tail, s = _split(frame, cols_used, ndev)
+    fn = build_callable(graph, list(fetch_edges), feed_names)
+    acc: Dict[str, List] = {n: [] for n in out_names}
+    if s > 0:
+        in_specs = _mesh_in_specs(
+            feed_names, {}, main, col_of=feed_map.__getitem__
+        )
+        spec_sig = ";".join(str(sp) for sp in in_specs)
+        sharded = ex.cached(
+            f"shmap-fused-{_mesh_sig(mesh)}-[{spec_sig}]",
+            graph,
+            fetch_edges,
+            feed_names,
+            lambda: jax.jit(
+                shard_map(
+                    fn, mesh=mesh, in_specs=in_specs, out_specs=P("data")
+                )
+            ),
+        )
+        outs = sharded(*[main[c] for c in cols_used])
+        maybe_check_numerics(out_names, outs, "lazy fused map (mesh shards)")
+        for n, o in zip(out_names, outs):
+            if o.shape[0] != s * ndev:
+                raise ValueError(
+                    f"lazy plan output {n!r} does not preserve the row "
+                    "count; trimmed/reducing stages cannot be part of a "
+                    "lazy map plan"
+                )
+            acc[n].append(o)
+    if cols_used and tail[cols_used[0]].shape[0] > 0:
+        tfn = ex.callable_for(graph, fetch_edges, feed_names)
+        outs = tfn(*[tail[c] for c in cols_used])
+        maybe_check_numerics(out_names, outs, "lazy fused map (mesh tail)")
+        trows = tail[cols_used[0]].shape[0]
+        for n, o in zip(out_names, outs):
+            if o.ndim == 0 or o.shape[0] != trows:
+                raise ValueError(
+                    f"lazy plan output {n!r} does not preserve the row "
+                    "count; trimmed/reducing stages cannot be part of a "
+                    "lazy map plan"
+                )
+            acc[n].append(o)
+    out_cols = [
+        Column(n, _api._concat_parts(acc[n])) for n in out_names if acc[n]
+    ]
+    shadow = set(out_names)
+    cols = out_cols + [
+        frame.column(c) for c in frame.columns if c not in shadow
+    ]
+    return TensorFrame(cols, frame.offsets)
+
+
+def fused_reduce_blocks(
+    fused_graph: Graph,
+    fused_fetches: Sequence[str],
+    feed_map: Dict[str, str],
+    frame: TensorFrame,
+    rgraph: Graph,
+    rfetch: Sequence[str],
+    rfeed_names: Sequence[str],
+    feed_src: Sequence[int],
+    mesh: Mesh,
+    executor: Optional[Executor] = None,
+) -> Tuple:
+    """Terminal fused reduce on the mesh: shard-local map chain + block
+    reduce run as ONE ``shard_map`` program (fused graph), the gathered
+    partials re-reduce through the PLAIN reduce graph inside the same
+    program — the `reduce_blocks` local_then_gather topology with the
+    whole pending pipeline in the local stage. Returns the final fetch
+    tuple (in ``rfetch`` order); the caller unwraps."""
+    ex = executor or default_executor()
+    feed_names = sorted(feed_map)
+    cols_used = [feed_map[n] for n in feed_names]
+    _api._require_dense(frame, cols_used, "reduce_blocks")
+    ndev = mesh.devices.size
+    main, tail, s = _split(frame, cols_used, ndev)
+    fn = build_callable(fused_graph, list(fused_fetches), feed_names)
+    rfn = build_callable(rgraph, list(rfetch), list(rfeed_names))
+
+    partials: List[Tuple] = []
+    if s > 0:
+        def local_then_gather(*cols):
+            part = fn(*cols)
+            gathered = [
+                lax.all_gather(part[i], "data", axis=0, tiled=False)
+                for i in feed_src
+            ]
+            return tuple(rfn(*gathered))
+
+        in_specs = _mesh_in_specs(
+            feed_names, {}, main, col_of=feed_map.__getitem__
+        )
+        sharded = ex.cached(
+            f"shred-fused-{_mesh_sig(mesh)}",
+            fused_graph,
+            fused_fetches,
+            feed_names,
+            lambda: jax.jit(
+                shard_map(
+                    local_then_gather,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            ),
+        )
+        outs = sharded(*[main[c] for c in cols_used])
+        partials.append(tuple(outs))
+    if cols_used and tail[cols_used[0]].shape[0] > 0:
+        tfn = ex.callable_for(fused_graph, fused_fetches, feed_names)
+        outs = tfn(*[tail[c] for c in cols_used])
+        partials.append(tuple(outs))
+    if not partials:
+        raise ValueError("reduce_blocks on an empty frame")
+    if len(partials) == 1:
+        final = tuple(partials[0])
+    else:
+        crfn = ex.callable_for(rgraph, rfetch, rfeed_names)
+        stacked = [
+            _api._stack_parts([p[i] for p in partials]) for i in feed_src
+        ]
+        final = tuple(crfn(*stacked))
+    maybe_check_numerics(list(rfetch), list(final), "reduce_blocks (mesh, fused)")
+    return final
 
 
 # ---------------------------------------------------------------------------

@@ -492,6 +492,15 @@ class ArgDef:
                 type_attr = v.decode("utf-8")
         return cls(name, typ, type_attr)
 
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        wire.write_string_field(out, 1, self.name)
+        if self.type is not None:
+            wire.write_varint_field(out, 3, self.type.tf_datatype)
+        if self.type_attr:
+            wire.write_string_field(out, 4, self.type_attr)
+        return bytes(out)
+
 
 @dataclass
 class FunctionDef:
@@ -531,6 +540,28 @@ class FunctionDef:
                 fd.ret[k] = rv
         return fd
 
+    def to_bytes(self) -> bytes:
+        """Serialize a programmatically built FunctionDef (signature +
+        body + ret map). Attrs outside this model (e.g. per-function
+        attr maps) are not emitted — parsed functions re-serialize
+        byte-stably through the enclosing library's ``raw`` instead."""
+        sig = bytearray()
+        wire.write_string_field(sig, 1, self.name)
+        for a in self.input_args:
+            wire.write_len_field(sig, 2, a.to_bytes())
+        for a in self.output_args:
+            wire.write_len_field(sig, 3, a.to_bytes())
+        out = bytearray()
+        wire.write_len_field(out, 1, bytes(sig))
+        for n in self.nodes:
+            wire.write_len_field(out, 3, n.to_bytes())
+        for k in sorted(self.ret):
+            entry = bytearray()
+            wire.write_string_field(entry, 1, k)
+            wire.write_string_field(entry, 2, self.ret[k])
+            wire.write_len_field(out, 4, bytes(entry))
+        return bytes(out)
+
 
 @dataclass
 class FunctionDefLibrary:
@@ -546,7 +577,16 @@ class FunctionDefLibrary:
         return cls(fns, data)
 
     def to_bytes(self) -> bytes:
-        return self.raw
+        """Parsed libraries re-serialize byte-stably from ``raw``;
+        programmatically built ones (raw empty, e.g. the merged library
+        of a fused graph) serialize from ``functions`` — previously they
+        silently dropped every function on the wire."""
+        if self.raw:
+            return self.raw
+        out = bytearray()
+        for f in self.functions:
+            wire.write_len_field(out, 1, f.to_bytes())
+        return bytes(out)
 
     def by_name(self) -> Dict[str, FunctionDef]:
         return {f.name: f for f in self.functions}

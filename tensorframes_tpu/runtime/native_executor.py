@@ -248,6 +248,12 @@ class NativeExecutor:
             lambda: build_callable(graph, list(fetches), list(feed_names)),
         )
 
+    def cache_keys(self):
+        """Interface parity with `Executor.cache_keys` (live compile-cache
+        key snapshot; the fusion bench/tests count kinds through it)."""
+        with self._lock:
+            return list(self._cache.keys())
+
     def run(
         self,
         graph: Graph,

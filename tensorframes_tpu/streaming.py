@@ -147,6 +147,12 @@ def reduce_blocks_stream(
     north star (1B-row vector reduce_sum) run in bounded host memory
     unconditionally.
 
+    Chunks may be `LazyFrame`s (a pending map chain over each chunk):
+    the per-chunk dispatch routes through the lazy terminal, so each
+    chunk's map stages and its block reduce run as ONE fused program
+    per block — the combine over partials still runs the plain reduce
+    graph, so fold semantics are unchanged.
+
     Combining partials through the same graph assumes the reduce is
     ASSOCIATIVE over blocks (sum/min/max/...) — the same contract as the
     reference's pairwise partial combine (`reducePairBlock`,
@@ -189,8 +195,12 @@ def reduce_blocks_stream(
         # local single-device path — the mesh path owns its own
         # sharded placement — and only for real frames (tests feed
         # plain dicts through here). Already-device columns pass
-        # through untouched (to_device skips them).
-        if isinstance(f, TensorFrame):
+        # through untouched (to_device skips them). LazyFrame chunks
+        # stage their BASE frame (the pending plan rides along and
+        # fuses with the reduce at dispatch below).
+        from .lazy import LazyFrame
+
+        if isinstance(f, (LazyFrame, TensorFrame)):
             try:
                 return f.to_device()
             except Exception as e:

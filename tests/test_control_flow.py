@@ -270,3 +270,132 @@ class TestErrorSurfaces:
         gg = Graph.from_bytes(gd.SerializeToString())
         with pytest.raises((GraphLoweringError, ValueError), match="value_index"):
             functionalize(gg, ["y", "take_index"])
+
+
+class TestFdefEdgeOutputArgs:
+    """`node:out_arg:idx` edges must resolve named out_args to FLAT
+    output offsets via the op's output signature — previously the
+    out_arg name was dropped and the within-arg index used positionally,
+    so e.g. a FusedBatchNorm's batch_mean silently aliased output 0."""
+
+    def test_multi_output_named_args_resolve_to_offsets(self):
+        from tensorframes_tpu.graph.control_flow import _fdef_edge
+
+        bodynames = {"bn", "tk", "mul"}
+        body_ops = {"bn": "FusedBatchNormV3", "tk": "TopKV2", "mul": "Mul"}
+        assert (
+            _fdef_edge("bn:batch_mean:0", {}, bodynames, "c/", body_ops)
+            == "c/bn:1"
+        )
+        assert (
+            _fdef_edge("bn:batch_variance:0", {}, bodynames, "c/", body_ops)
+            == "c/bn:2"
+        )
+        assert _fdef_edge("bn:y:0", {}, bodynames, "c/", body_ops) == "c/bn:0"
+        assert (
+            _fdef_edge("tk:indices:0", {}, bodynames, "c/", body_ops)
+            == "c/tk:1"
+        )
+        assert (
+            _fdef_edge("tk:values:0", {}, bodynames, "c/", body_ops)
+            == "c/tk:0"
+        )
+        # single-output ops: positional resolution is exact, any out_arg
+        assert _fdef_edge("mul:z:0", {}, bodynames, "c/", body_ops) == "c/mul:0"
+
+    def test_unknown_out_arg_on_tabled_op_raises(self):
+        from tensorframes_tpu.graph.control_flow import (
+            GraphLoweringError,
+            _fdef_edge,
+        )
+
+        with pytest.raises(GraphLoweringError, match="no output arg"):
+            _fdef_edge(
+                "tk:bogus:0", {}, {"tk"}, "c/", {"tk": "TopKV2"}
+            )
+
+    def test_topk_indices_through_function_call(self):
+        # end to end: a @tf.function fetching top_k INDICES (output :1)
+        # must inline to the second output, not the values
+        @tf.function
+        def inner(a):
+            vals, idx = tf.nn.top_k(a, k=2)
+            return tf.cast(idx, tf.float32)
+
+        @tf.function
+        def f(a):
+            return inner(a) + 0.0
+
+        conc = f.get_concrete_function(
+            tf.TensorSpec(shape=(4,), dtype=tf.float32)
+        )
+        gd = conc.graph.as_graph_def()
+        out_name = conc.outputs[0].name.split(":")[0]
+        in_name = conc.inputs[0].name.split(":")[0]
+        data = gd.SerializeToString()
+        x = np.array([3.0, 9.0, 1.0, 7.0], dtype=np.float32)
+        df = tfs.TensorFrame.from_dict({in_name: [x]})
+        out = tfs.map_rows(data, df, fetch_names=[out_name])
+        np.testing.assert_array_equal(
+            np.asarray(out[out_name].values)[0], [1.0, 3.0]
+        )
+
+
+class TestInteriorLeakDetection:
+    """Fetching (or consuming) an interior node of an extracted loop or
+    cond must raise a `GraphLoweringError` naming the leak, not a bare
+    KeyError from a later pass."""
+
+    def test_cond_interior_fetch_raises_named_error(self):
+        from tensorframes_tpu.graph.control_flow import GraphLoweringError
+
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                x = tf1.placeholder(tf.float32, shape=(), name="x")
+                c = tf.cond(
+                    x > 0.0,
+                    lambda: tf.multiply(x, 2.0, name="inner_mul"),
+                    lambda: x - 5.0,
+                )
+                tf.identity(c, name="out")
+            gd = g.as_graph_def()
+        finally:
+            tf1.enable_control_flow_v2()
+        interior = next(
+            n.name for n in gd.node if n.name.endswith("inner_mul")
+        )
+        gg = Graph.from_bytes(gd.SerializeToString())
+        with pytest.raises(GraphLoweringError, match="interior"):
+            functionalize(gg, ["out", interior])
+
+    def test_while_interior_fetch_raises_named_error(self):
+        from tensorframes_tpu.graph.control_flow import GraphLoweringError
+
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                x = tf1.placeholder(tf.float32, shape=(), name="x")
+                i0 = tf.constant(0)
+                acc0 = tf.constant(1.0)
+
+                def body(i, acc):
+                    return i + 1, tf.multiply(
+                        acc, x + 1.0, name="body_mul"
+                    )
+
+                _, acc_f = tf.while_loop(
+                    lambda i, acc: i < 3, body, [i0, acc0]
+                )
+                tf.identity(acc_f, name="out")
+            gd = g.as_graph_def()
+        finally:
+            tf1.enable_control_flow_v2()
+        interior = next(
+            n.name for n in gd.node if n.name.endswith("body_mul")
+        )
+        gg = Graph.from_bytes(gd.SerializeToString())
+        with pytest.raises(GraphLoweringError, match="interior"):
+            functionalize(gg, ["out", interior])

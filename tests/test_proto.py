@@ -167,3 +167,64 @@ class TestReferenceConformance:
         g2 = GraphDef.from_bytes(g.to_bytes())
         assert [n.name for n in g2.nodes] == names
         assert [n.op for n in g2.nodes] == [n.op for n in g.nodes]
+
+
+class TestFunctionDefLibrarySerialization:
+    """Programmatically built libraries (raw empty) must serialize from
+    `.functions` — previously `to_bytes` returned only `self.raw`, so
+    they silently dropped every function on the wire."""
+
+    def _lib(self):
+        from tensorframes_tpu.proto.graphdef import (
+            ArgDef,
+            FunctionDef,
+            FunctionDefLibrary,
+        )
+
+        fd = FunctionDef(
+            name="double",
+            input_args=[ArgDef("a", ScalarType.float32)],
+            output_args=[ArgDef("out", ScalarType.float32)],
+            nodes=[
+                NodeDef(
+                    "mul",
+                    "Mul",
+                    ["a", "mul/y"],
+                    {"T": AttrValue.of_type(ScalarType.float32)},
+                )
+            ],
+            ret={"out": "mul:z:0"},
+        )
+        return FunctionDefLibrary([fd])
+
+    def test_programmatic_library_roundtrips(self):
+        from tensorframes_tpu.proto.graphdef import FunctionDefLibrary
+
+        lib = self._lib()
+        data = lib.to_bytes()
+        assert data, "programmatic library must not serialize to nothing"
+        back = FunctionDefLibrary.from_bytes(data)
+        assert [f.name for f in back.functions] == ["double"]
+        fd = back.functions[0]
+        assert [a.name for a in fd.input_args] == ["a"]
+        assert fd.input_args[0].type is ScalarType.float32
+        assert [a.name for a in fd.output_args] == ["out"]
+        assert fd.ret == {"out": "mul:z:0"}
+        assert [n.op for n in fd.nodes] == ["Mul"]
+
+    def test_parsed_library_stays_byte_stable(self):
+        lib = self._lib()
+        from tensorframes_tpu.proto.graphdef import FunctionDefLibrary
+
+        parsed = FunctionDefLibrary.from_bytes(lib.to_bytes())
+        # parsed libraries keep re-serializing their raw bytes verbatim
+        assert parsed.to_bytes() == lib.to_bytes()
+
+    def test_graphdef_carries_programmatic_library(self):
+        gd = GraphDef(
+            nodes=[NodeDef("x", "Placeholder", [], {})],
+            library=self._lib(),
+        )
+        back = GraphDef.from_bytes(gd.to_bytes())
+        assert back.library is not None
+        assert [f.name for f in back.library.functions] == ["double"]

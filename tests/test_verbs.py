@@ -1347,3 +1347,19 @@ class TestMultiKeyAggregate:
             np.stack(pdf["v"].to_numpy()),
             np.array([[2.0, 4.0], [4.0, 5.0], [6.0, 7.0]]),
         )
+
+
+class TestFunctionEmptyOutputDict:
+    """A function graph returning an empty dict must fail at the verb
+    with the cause named — previously the trim path sailed through and
+    exploded later in np.cumsum over a None block size."""
+
+    def test_map_blocks_trim_empty_dict_verb_error(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)}, num_blocks=2)
+        with pytest.raises(ValueError, match="empty dict"):
+            tfs.map_blocks(lambda x: {}, df, trim=True)
+
+    def test_map_rows_empty_dict_verb_error(self):
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        with pytest.raises(ValueError, match="empty dict"):
+            tfs.map_rows(lambda x: {}, df)

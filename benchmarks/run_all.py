@@ -45,6 +45,13 @@ SMOKE_SIZES = {
     "FUSE_ROWS": "100000",
     "FUSE_BLOCKS": "4",
     "FUSE_ITERS": "3",
+    # bucketing smoke keeps the REQUIRED 64 distinct block sizes (the
+    # compile-count contract is about size cardinality, not row volume)
+    # but shrinks every block to a handful of rows
+    "BUCKET_BLOCKS": "64",
+    "BUCKET_BASE": "5",
+    "BUCKET_STEP": "3",
+    "BUCKET_ITERS": "1",
 }
 
 
@@ -58,6 +65,7 @@ def main():
         "convert_bench",
         "pipeline_bench",
         "fusion_bench",
+        "bucketing_bench",
         "map_sum_bench",
         "kmeans_bench",
         "map_rows_mlp_bench",

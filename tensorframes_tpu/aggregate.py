@@ -99,6 +99,55 @@ _ROWWISE_OPS = {
 }
 
 
+def _rowwise_transform(graph: Graph, roots, ph_rank) -> bool:
+    """THE row-local walk both classifiers share (`_chunk_combiners`
+    below and `shape_policy.rowwise_fetches`): every node reachable from
+    ``roots`` is a Placeholder (block rank via the ``ph_rank(name)``
+    callable, None = unknown → reject), a Const, or an op in
+    `_ROWWISE_OPS`; all placeholders agree on ONE lead rank; and every
+    constant stays strictly below it (or has an explicit size-1 lead) —
+    a lead-rank constant broadcasts along the row axis, so sliced/padded
+    feeds would mismatch it. One implementation so map-bucketing
+    eligibility can never silently diverge from reduce-chunk
+    eligibility."""
+    seen: set = set()
+    stack = [_base(r) for r in roots]
+    const_shapes: List[tuple] = []
+    ranks: set = set()
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        try:
+            node = graph[name]
+        except KeyError:
+            return False
+        if node.op in ("Placeholder", "PlaceholderV2"):
+            r = ph_rank(name)
+            if r is None:
+                return False
+            ranks.add(int(r))
+            continue
+        if node.op == "Const":
+            const_shapes.append(
+                tuple(node.attrs["value"].value.to_numpy().shape)
+            )
+            continue
+        if node.op not in _ROWWISE_OPS:
+            return False
+        stack.extend(src for src, _ in node.data_inputs())
+    if len(ranks) != 1:
+        return False
+    lead_rank = ranks.pop()
+    for cs in const_shapes:
+        if len(cs) > lead_rank or (
+            len(cs) == lead_rank and cs and cs[0] != 1
+        ):
+            return False
+    return True
+
+
 def _chunk_combiners(
     graph: Graph, fetch_list: List[str], summary: GraphSummary,
     require_direct: bool = False,
@@ -149,45 +198,20 @@ def _chunk_combiners(
         axes = idx_node.attrs["value"].value.to_numpy().ravel().tolist()
         if axes != [0]:
             return None
-        # walk the transform subgraph: placeholder/const leaves, rowwise ops
-        seen = set()
-        stack = [data_in[0][0]]
-        ph_ranks = set()
-        const_shapes = []
-        while stack:
-            name = stack.pop()
-            if name in seen:
-                continue
-            seen.add(name)
-            n = graph[name]
-            if n.op in ("Placeholder", "PlaceholderV2"):
-                info = summary.inputs.get(name)
-                if info is None:
-                    return None
-                ph_ranks.add(len(info.shape.dims))
-                continue
-            if n.op == "Const":
-                const_shapes.append(
-                    n.attrs["value"].value.to_numpy().shape
-                )
-                continue
-            if n.op not in _ROWWISE_OPS:
-                return None
-            stack.extend(src for src, _ in n.data_inputs())
-        if len(ph_ranks) != 1:
-            return None  # mixed feed ranks: lead-axis alignment is murky
-        lead_rank = ph_ranks.pop()
-        for cshape in const_shapes:
-            # A lead-rank constant broadcasts along the group-size axis;
-            # chunked feeds slice that axis, so partials would mismatch
-            # (surfacing as an XLA broadcast error deep in the chunk
-            # stage). Only sub-lead-rank constants — or an explicit
-            # size-1 lead — are chunk-invariant; anything else falls
-            # back to the exact whole-group plan.
-            if len(cshape) > lead_rank or (
-                len(cshape) == lead_rank and cshape and cshape[0] != 1
-            ):
-                return None
+        # walk the transform subgraph: placeholder/const leaves, rowwise
+        # ops, one lead rank, sub-lead-rank constants (`_rowwise_transform`
+        # — a lead-rank constant would broadcast along the group-size
+        # axis and mismatch sliced chunk feeds)
+        if not _rowwise_transform(
+            graph,
+            [data_in[0][0]],
+            lambda name: (
+                len(summary.inputs[name].shape.dims)
+                if name in summary.inputs
+                else None
+            ),
+        ):
+            return None
         out[_base(f)] = _CHUNK_COMBINERS[node.op]
     return out
 

@@ -633,6 +633,23 @@ def map_blocks(
 
     feed_names = sorted(summary.inputs)
     fn = ex.callable_for(graph, fetch_list, feed_names)
+    # Shape bucketing (`shape_policy`): pad row-local graphs' block feeds
+    # up to the bucket ladder and slice the pad rows off every output, so
+    # drifting block sizes compile O(log max-rows) jit specializations of
+    # this program instead of one per distinct size. trim/bindings/
+    # non-rowwise graphs keep the exact per-shape dispatch.
+    from . import shape_policy as _sp
+
+    bucketed = (
+        not trim
+        and not bindings
+        and _sp.enabled(ex)
+        and _sp.rowwise_fetches(
+            graph,
+            fetch_list,
+            {p: ph.shape.rank for p, ph in summary.inputs.items()},
+        )
+    )
 
     acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
     out_sizes: List[int] = []
@@ -652,6 +669,9 @@ def map_blocks(
             )
             for n in feed_names
         ]
+        bucket = hi - lo
+        if bucketed:
+            feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
         from . import config as _config
         from .runtime.retry import run_with_retries
 
@@ -660,6 +680,7 @@ def map_blocks(
             attempts=_config.get().block_retry_attempts,
             what=f"map_blocks block {bi}",
         )
+        outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
         maybe_check_numerics(fetch_list, outs, f"map_blocks block {bi}")
         bsize = None
         for f, o in zip(fetch_list, outs):
@@ -976,7 +997,24 @@ def reduce_blocks(
     _require_dense(frame, list(mapping.values()), "reduce_blocks")
 
     feed_names = sorted(summary.inputs)
-    fn = ex.callable_for(graph, fetch_list, feed_names)
+    # Shape bucketing: graphs the chunk classifier proves to be monoid
+    # reduces over row-local transforms run a MASKED bucketed program
+    # ("block-bucketed" kind) — block feeds pad to the bucket ladder and
+    # pad rows mask to the reduction identity at the transform output,
+    # so drifting block sizes compile O(log max-rows) programs. The
+    # `valid` row count rides as a traced scalar (no respecialization
+    # within a bucket). Unclassifiable graphs keep the exact program.
+    from . import shape_policy as _sp
+
+    mask_plan = (
+        _sp.masked_reduce_plan(graph, fetch_list, summary)
+        if _sp.enabled(ex)
+        else None
+    )
+    if mask_plan is not None:
+        fn = _sp.masked_callable(ex, graph, fetch_list, feed_names, mask_plan)
+    else:
+        fn = ex.callable_for(graph, fetch_list, feed_names)
     # feed_src[j] = fetch whose partial re-feeds feed_names[j] (fetch
     # order and sorted-feed order differ with several fetches)
     fetch_of_feed = {_base(f) + "_input": i for i, f in enumerate(fetch_list)}
@@ -993,8 +1031,15 @@ def reduce_blocks(
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
         if lo == hi:
+            # zero-row blocks (repartition(num_blocks > nrows)) are never
+            # dispatched: a padded all-pad block would contribute the bare
+            # reduction identity (e.g. +inf for Min) and poison the combine
             continue
-        outs = fn(*[frame.column(mapping[n]).values[lo:hi] for n in feed_names])
+        feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
+        if mask_plan is not None:
+            outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+        else:
+            outs = fn(*feeds)
         maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
         partials.append(tuple(outs))
     if not partials:

@@ -67,6 +67,33 @@ class Config:
     # graphs / shapes drift would otherwise accumulate compiled
     # executables forever (the cache is never cleared implicitly).
     executor_cache_entries: int = 512
+    # Shape-bucketed block execution (`shape_policy`): pad every block
+    # feed up to a geometric row-bucket ladder and mask/slice the pad
+    # rows, so a workload with arbitrary drifting block sizes compiles
+    # O(log max-block-rows) XLA programs per graph instead of one per
+    # distinct size. Applies only to dispatches proven safe (row-local
+    # map graphs; monoid-classified reduces); everything else runs the
+    # exact unbucketed program regardless of this knob. Float sum/mean
+    # under bucketing reduce over a padded axis, so XLA may reassociate
+    # the accumulation (the same tolerance as stacking block partials);
+    # turn this off when exact FP accumulation order outweighs bounded
+    # compile counts. Env override TFS_SHAPE_BUCKETING ("0" disables)
+    # seeds the initial value, mirroring TFS_NATIVE_EXECUTOR.
+    shape_bucketing: bool = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "TFS_SHAPE_BUCKETING", "1"
+        ).lower() not in ("0", "false", "off")
+    )
+    # Bucket-ladder geometry: rung k holds min * growth^k rows. Growth
+    # trades pad waste (worst-case (growth-1)/growth of a block) against
+    # ladder length (compile count ~ log_growth(max rows)).
+    shape_bucket_growth: float = 2.0
+    shape_bucket_min: int = 8
+    # One-time per-program warning when jit has compiled more than this
+    # many distinct input shapes for a single cached program — the
+    # recompile-storm signal `compile_count` (distinct lowered callables)
+    # structurally cannot see. 0 disables the check.
+    recompile_warn_shapes: int = 16
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).

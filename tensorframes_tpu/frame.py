@@ -396,6 +396,23 @@ class TensorFrame:
             for i in range(self.num_blocks)
         ]
 
+    def bucketed_block_sizes(self) -> List[int]:
+        """The block-lead shapes dispatch will actually compile for this
+        frame under the current shape policy: the bucket-ladder rung per
+        block (`shape_policy.bucket_for`) with ``config.shape_bucketing``
+        on, the raw `block_sizes` with it off — so ``len(set(...))`` is
+        an honest compiled-shape budget either way (the introspection
+        surface `benchmarks/bucketing_bench.py` and the bucketing tests
+        assert against). Empty blocks map to 0 (never dispatched).
+        Per-dispatch eligibility (non-row-local maps, unclassified
+        reduces) can still keep individual programs on the raw sizes."""
+        from . import config as _config
+        from .shape_policy import bucket_for
+
+        if not _config.get().shape_bucketing:
+            return self.block_sizes()
+        return [bucket_for(n) for n in self.block_sizes()]
+
     def block(self, i: int) -> "TensorFrame":
         lo, hi = self.offsets[i], self.offsets[i + 1]
         return TensorFrame([c.slice(lo, hi) for c in self._cols.values()])

@@ -416,6 +416,27 @@ class LazyFrame:
         _api._require_dense(
             frame, [feed_map[n] for n in feed_names], "reduce_blocks"
         )
+        # Shape bucketing for the fused chain: the reduce graph must
+        # classify as a monoid over row-local transforms AND the whole
+        # pending map chain feeding each reduce root must itself be
+        # row-local in the fused graph (fused_mask_plan re-walks it) —
+        # then ONE masked bucketed program serves every block size.
+        from . import shape_policy as _sp
+        from .aggregate import _chunk_combiners
+
+        mask_plan = None
+        if mesh is None and _sp.enabled(ex):
+            classified = _chunk_combiners(rgraph, rfetch, rsummary)
+            if classified is not None:
+                mask_plan = _sp.fused_mask_plan(
+                    fused,
+                    fused_fetches,
+                    [classified[_base(f)] for f in rfetch],
+                    {
+                        ph: frame.info[col].block_shape.rank
+                        for ph, col in feed_map.items()
+                    },
+                )
         # distinct profiling key: the module verb's decorator already
         # records "reduce_blocks" around this call, and fused-vs-eager
         # dispatch is worth telling apart in stats anyway
@@ -428,18 +449,28 @@ class LazyFrame:
                     rgraph, rfetch, rfeed_names, feed_src, mesh, ex,
                 )
             else:
-                fn = ex.callable_for(fused, fused_fetches, feed_names)
+                if mask_plan is not None:
+                    fn = _sp.masked_callable(
+                        ex, fused, fused_fetches, feed_names, mask_plan
+                    )
+                else:
+                    fn = ex.callable_for(fused, fused_fetches, feed_names)
                 partials: List[Tuple] = []
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
                     if lo == hi:
+                        # zero-row blocks never dispatch (a padded all-pad
+                        # block would emit the bare reduction identity and
+                        # poison the combine — e.g. +inf partials for Min)
                         continue
-                    outs = fn(
-                        *[
-                            frame.column(feed_map[n]).values[lo:hi]
-                            for n in feed_names
-                        ]
-                    )
+                    feeds = [
+                        frame.column(feed_map[n]).values[lo:hi]
+                        for n in feed_names
+                    ]
+                    if mask_plan is not None:
+                        outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+                    else:
+                        outs = fn(*feeds)
                     maybe_check_numerics(
                         rfetch, outs, f"reduce_blocks (fused) block {bi}"
                     )
@@ -517,6 +548,20 @@ class LazyFrame:
                 )
             else:
                 fn = ex.callable_for(self._graph, fetch_edges, feed_names)
+                # shape bucketing: a row-local fused chain pads each
+                # block to the bucket ladder and slices pad rows off the
+                # outputs — same policy as eager map_blocks, one program
+                # shape per ladder rung instead of per block size
+                from . import shape_policy as _sp
+
+                bucketed = _sp.enabled(ex) and _sp.rowwise_fetches(
+                    self._graph,
+                    fetch_edges,
+                    {
+                        ph: frame.info[col].block_shape.rank
+                        for ph, col in self._feed_map.items()
+                    },
+                )
                 acc: Dict[str, List] = {n: [] for n in out_names}
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -526,7 +571,11 @@ class LazyFrame:
                         frame.column(self._feed_map[n]).values[lo:hi]
                         for n in feed_names
                     ]
+                    bucket = hi - lo
+                    if bucketed:
+                        feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
                     outs = fn(*feeds)
+                    outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
                     maybe_check_numerics(
                         out_names, outs, f"lazy fused block {bi}"
                     )

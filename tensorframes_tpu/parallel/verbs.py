@@ -90,6 +90,29 @@ def _split(frame: TensorFrame, cols: Sequence[str], ndev: int):
     return main, tail, s
 
 
+def _bucketed_or_split(ex, frame, cols_used, ndev, graph, fetches, ph_ranks):
+    """THE map-verb mesh bucketing gate (`map_blocks` and
+    `fused_map_blocks` share it): when the shape policy is on and the
+    graph is row-local, pad the whole frame so every shard is one
+    bucket-ladder rung — one static `shard_map` shape per rung, no
+    varying-remainder tail program; pad rows replicate the last row and
+    are sliced off by the caller. Otherwise the ordinary `_split`.
+    Returns ``(main, tail, s, pad_rows)`` with ``pad_rows == 0`` on the
+    unbucketed path."""
+    from .. import shape_policy as _sp
+
+    if (
+        cols_used
+        and frame.nrows > 0
+        and _sp.enabled(ex)
+        and _sp.rowwise_fetches(graph, fetches, ph_ranks)
+    ):
+        main, tail, s, _ = _sp.pad_mesh_shards(frame, cols_used, ndev)
+        return main, tail, s, s * ndev - frame.nrows
+    main, tail, s = _split(frame, cols_used, ndev)
+    return main, tail, s, 0
+
+
 def _mesh_in_specs(params, bindings, main, col_of=None):
     """shard_map in_specs shared by every mesh map verb: bound args are
     replicated (P(None...)), column feeds shard their lead dim over the
@@ -147,7 +170,14 @@ def map_blocks(
     col_feeds = [n for n in feed_names if n not in bindings]
     cols_used = [mapping[n] for n in col_feeds]
     ndev = mesh.devices.size
-    main, tail, s = _split(frame, cols_used, ndev)
+    if trim or bindings:  # trim changes row counts; bindings replicate
+        main, tail, s = _split(frame, cols_used, ndev)
+        pad_rows = 0
+    else:
+        main, tail, s, pad_rows = _bucketed_or_split(
+            ex, frame, cols_used, ndev, graph, fetch_list,
+            {p: ph.shape.rank for p, ph in summary.inputs.items()},
+        )
 
     fn = build_callable(graph, fetch_list, feed_names)
     acc: Dict[str, List] = {_base(f): [] for f in fetch_list}
@@ -196,7 +226,7 @@ def map_blocks(
                     raise ValueError(
                         "map_blocks(trim): outputs disagree on row count"
                     )
-            acc[_base(f)].append(o)
+            acc[_base(f)].append(o[: frame.nrows] if pad_rows else o)
         block_sizes += [shard_out if trim else s] * ndev
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
@@ -615,7 +645,13 @@ def fused_map_blocks(
     cols_used = [feed_map[n] for n in feed_names]
     _api._require_dense(frame, cols_used, "lazy.force")
     ndev = mesh.devices.size
-    main, tail, s = _split(frame, cols_used, ndev)
+    main, tail, s, pad_rows = _bucketed_or_split(
+        ex, frame, cols_used, ndev, graph, fetch_edges,
+        {
+            ph: frame.info[col].block_shape.rank
+            for ph, col in feed_map.items()
+        },
+    )
     fn = build_callable(graph, list(fetch_edges), feed_names)
     acc: Dict[str, List] = {n: [] for n in out_names}
     if s > 0:
@@ -643,7 +679,7 @@ def fused_map_blocks(
                     "count; trimmed/reducing stages cannot be part of a "
                     "lazy map plan"
                 )
-            acc[n].append(o)
+            acc[n].append(o[: frame.nrows] if pad_rows else o)
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_edges, feed_names)
         outs = tfn(*[tail[c] for c in cols_used])
@@ -767,8 +803,51 @@ def reduce_blocks(
     feed_names = sorted(summary.inputs)
     cols_used = [mapping[n] for n in feed_names]
     ndev = mesh.devices.size
-    main, tail, s = _split(frame, cols_used, ndev)
     fn = build_callable(graph, fetch_list, feed_names)
+    # Both mesh reduce shapes drift with nrows — the sharded main
+    # program re-specializes per distinct nrows//ndev and the remainder
+    # tail per distinct nrows%ndev. For classified monoid graphs the
+    # main shards pad to the bucket ladder with per-shard valid counts
+    # masked inside the shard_map program (Mean excluded: regrouping
+    # shard boundaries would change the equal-weight partial combine),
+    # and the tail routes through the SAME masked bucketed program as
+    # the local verb (shared cache entry) — both bounded to the ladder.
+    from .. import shape_policy as _sp
+
+    mask_plan = (
+        _sp.masked_reduce_plan(graph, fetch_list, summary)
+        if _sp.enabled(ex)
+        else None
+    )
+    bucket_shards = (
+        mask_plan is not None
+        and "mean" not in mask_plan.combiners
+        and cols_used
+        and frame.nrows > 0
+    )
+    if bucket_shards and not (
+        _sp.mesh_shard_plan(frame.nrows, ndev)[1] > 0
+    ).all():
+        # An all-pad shard emits the BARE reduction identity, and the
+        # gathered combine re-feeds partials through the whole graph —
+        # identity values are neutral there only when each reduce
+        # consumes its placeholder DIRECTLY (Max(Abs(x)) would turn the
+        # -inf identity into +inf). Same reasoning as streaming's
+        # require_direct tree-fold gate; indirect graphs fall back to
+        # the unbucketed shards + masked tail. Decided on the plan's
+        # pure arithmetic, BEFORE paying for any padded column copy.
+        bucket_shards = (
+            _api._chunk_combiners(
+                graph, fetch_list, summary, require_direct=True
+            )
+            is not None
+        )
+    if bucket_shards:
+        main, tail, s, shard_valids = _sp.pad_mesh_shards(
+            frame, cols_used, ndev
+        )
+    else:
+        main, tail, s = _split(frame, cols_used, ndev)
     # Combining partials re-feeds fn: outputs arrive in FETCH order but
     # fn's positional args are the SORTED feed names, and with several
     # fetches those orders differ (x/n fetches sort as n_input, x_input)
@@ -780,38 +859,78 @@ def reduce_blocks(
 
     partials: List[Tuple[np.ndarray, ...]] = []
     if s > 0:
-        def local_then_gather(*cols):
-            part = fn(*cols)
-            gathered = [
-                lax.all_gather(part[i], "data", axis=0, tiled=False)
-                for i in feed_src
-            ]
-            final = fn(*gathered)
-            return tuple(final)
-
-        in_specs = tuple(
+        col_specs = tuple(
             P("data", *([None] * (main[c].ndim - 1))) for c in cols_used
         )
-        sharded = ex.cached(
-            f"shred-{_mesh_sig(mesh)}",
-            graph,
-            fetch_list,
-            feed_names,
-            lambda: jax.jit(
-                shard_map(
-                    local_then_gather,
-                    mesh=mesh,
-                    in_specs=in_specs,
-                    out_specs=P(),  # combined result is replicated
-                    check_vma=False,
+        if bucket_shards:
+            def make_masked_sharded():
+                mraw = _sp.build_masked_reduce(graph, mask_plan, feed_names)
+
+                def local_then_gather_masked(valid, *cols):
+                    # valid arrives as this shard's (1,) slice of the
+                    # per-shard counts; build_masked_reduce squeezes it
+                    part = mraw(valid, *cols)
+                    gathered = [
+                        lax.all_gather(part[i], "data", axis=0, tiled=False)
+                        for i in feed_src
+                    ]
+                    return tuple(fn(*gathered))
+
+                return jax.jit(
+                    shard_map(
+                        local_then_gather_masked,
+                        mesh=mesh,
+                        in_specs=(P("data"),) + col_specs,
+                        out_specs=P(),
+                        check_vma=False,
+                    )
                 )
-            ),
-        )
-        outs = sharded(*[main[c] for c in cols_used])
+
+            sharded = ex.cached(
+                f"shred-bkt-{_mesh_sig(mesh)}",
+                graph,
+                fetch_list,
+                feed_names,
+                make_masked_sharded,
+            )
+            outs = sharded(shard_valids, *[main[c] for c in cols_used])
+        else:
+            def local_then_gather(*cols):
+                part = fn(*cols)
+                gathered = [
+                    lax.all_gather(part[i], "data", axis=0, tiled=False)
+                    for i in feed_src
+                ]
+                final = fn(*gathered)
+                return tuple(final)
+
+            sharded = ex.cached(
+                f"shred-{_mesh_sig(mesh)}",
+                graph,
+                fetch_list,
+                feed_names,
+                lambda: jax.jit(
+                    shard_map(
+                        local_then_gather,
+                        mesh=mesh,
+                        in_specs=col_specs,
+                        out_specs=P(),  # combined result is replicated
+                        check_vma=False,
+                    )
+                ),
+            )
+            outs = sharded(*[main[c] for c in cols_used])
         partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
-        tfn = ex.callable_for(graph, fetch_list, feed_names)
-        outs = tfn(*[tail[c] for c in cols_used])
+        t = [tail[c] for c in cols_used]
+        if mask_plan is not None:
+            mfn = _sp.masked_callable(
+                ex, graph, fetch_list, feed_names, mask_plan
+            )
+            outs = _sp.dispatch_masked(mfn, t, t[0].shape[0])
+        else:
+            tfn = ex.callable_for(graph, fetch_list, feed_names)
+            outs = tfn(*t)
         partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")

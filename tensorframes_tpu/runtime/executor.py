@@ -53,6 +53,10 @@ class Executor:
     # its own buffer protocol, so `NativeExecutor` sets this False and
     # verbs build non-donating combines for it.
     supports_donation = True
+    # Verbs may route eligible dispatches through the shape-bucketing
+    # policy (`shape_policy`) on this executor: jit re-specializes per
+    # concrete shape, so quantizing block shapes bounds its compiles.
+    supports_bucketing = True
 
     def __init__(self):
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
@@ -62,6 +66,9 @@ class Executor:
         # a recompile storm shows up as misses growing with call count
         self.cache_hits = 0
         self.cache_misses = 0
+        # cached-program keys already flagged by the recompile-storm
+        # warning (one warning per program, ever)
+        self._storm_warned: set = set()
 
     def jit(self, fn: Callable) -> Callable:
         """Compile an arbitrary jittable for this executor's runtime.
@@ -89,7 +96,8 @@ class Executor:
         from .. import config as _config
 
         fn, inserted = lru_get_or_insert(
-            self._cache, self._lock, key, make,
+            self._cache, self._lock, key,
+            lambda: self._instrument(key, make()),
             _config.get().executor_cache_entries,
         )
         with self._lock:  # += is not atomic; keep the counts exact
@@ -99,6 +107,99 @@ class Executor:
             else:
                 self.cache_hits += 1
         return fn
+
+    def _instrument(self, key: Tuple, fn: Callable) -> Callable:
+        """Wrap a freshly built cached program with per-shape compile
+        observability. jit re-specializes (full XLA compile) per distinct
+        input shape signature, invisibly to `compile_count` — the
+        wrapper watches the jit cache size (`_cache_size`) and logs a
+        ONE-TIME recompile-storm warning when a single program crosses
+        `config.recompile_warn_shapes` distinct shapes. Programs without
+        a `_cache_size` (native-host wrappers, plain callables) pass
+        through untouched; the jit cache handle is re-exposed on the
+        wrapper so introspection (`jit_shape_compiles`, tests poking
+        `fn._cache_size()`) keeps working."""
+        sizer = getattr(fn, "_cache_size", None)
+        if not callable(sizer):
+            return fn
+
+        def wrapped(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            from .. import config as _config
+
+            threshold = _config.get().recompile_warn_shapes
+            if threshold and key not in self._storm_warned:
+                try:
+                    n = sizer()
+                except Exception:
+                    return out
+                if n > threshold:
+                    with self._lock:
+                        if key in self._storm_warned:
+                            return out
+                        # bounded: programs come and go in a long-lived
+                        # service while this set never follows cache
+                        # eviction — past the cap an arbitrary entry is
+                        # dropped (worst case: an evicted-and-rebuilt
+                        # program warns once more)
+                        while len(self._storm_warned) >= 1024:
+                            self._storm_warned.pop()
+                        self._storm_warned.add(key)
+                    from ..utils.log import get_logger
+
+                    if _config.get().shape_bucketing:
+                        # bucketing is already on: the storm means this
+                        # program is not bucketable (non-row-local map /
+                        # unclassified reduce) or the ladder itself is
+                        # longer than the threshold — don't send the
+                        # operator to a knob that is already set
+                        remedy = (
+                            "this program is not eligible for "
+                            "shape_bucketing (non-row-local or "
+                            "unclassified graph) or its bucket ladder "
+                            "exceeds the threshold; repartition to stable "
+                            "block sizes, coarsen shape_bucket_growth, or "
+                            "raise recompile_warn_shapes"
+                        )
+                    else:
+                        remedy = (
+                            "enable config.shape_bucketing (or "
+                            "repartition to stable block sizes) to bound "
+                            "XLA compiles"
+                        )
+                    get_logger("executor").warning(
+                        "recompile storm: program %s/%s has compiled %d "
+                        "distinct input shapes (> recompile_warn_shapes=%d);"
+                        " block shapes are drifting per call — %s",
+                        key[0], str(key[1])[:12], n, threshold, remedy,
+                    )
+            return out
+
+        wrapped._cache_size = sizer
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def jit_shape_compiles(self) -> int:
+        """Total XLA shape specializations across LIVE cached programs:
+        the sum of every program's jit cache size (each distinct input
+        shape signature = one real compile). This is the recompile-storm
+        metric `compile_count` cannot see — under shape bucketing it
+        stays O(log max-block-rows) per program no matter how block
+        sizes drift. Entries without a jit cache handle count as 1;
+        evicted entries' compiles are forgotten with them."""
+        with self._lock:
+            fns = list(self._cache.values())
+        total = 0
+        for fn in fns:
+            sizer = getattr(fn, "_cache_size", None)
+            if callable(sizer):
+                try:
+                    total += int(sizer())
+                    continue
+                except Exception:
+                    pass
+            total += 1
+        return total
 
     def callable_for(
         self,

@@ -77,6 +77,10 @@ class NativeExecutor:
     # donation aliasing is not part of that contract, so verbs build
     # non-donating combine programs for this executor.
     supports_donation = False
+    # Shape bucketing applies here too: `_native_run` compiles one host
+    # executable per input shape signature, so quantizing block shapes
+    # bounds native compiles exactly as it bounds jit specializations.
+    supports_bucketing = True
 
     def _bind_host(self, host, jax_fallback: bool = False) -> None:
         """All non-host state in one place (also the seam tests use to
@@ -253,6 +257,13 @@ class NativeExecutor:
         key snapshot; the fusion bench/tests count kinds through it)."""
         with self._lock:
             return list(self._cache.keys())
+
+    def jit_shape_compiles(self) -> int:
+        """Interface parity with `Executor.jit_shape_compiles`. The
+        native host compiles one executable per (program, input shape
+        signature) — and `compile_count` increments on exactly those
+        compiles — so here the two metrics coincide."""
+        return int(self.compile_count)
 
     def run(
         self,

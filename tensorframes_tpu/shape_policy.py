@@ -1,0 +1,375 @@
+"""Shape-bucketed block execution: bound XLA recompiles across ragged blocks.
+
+The executor caches ONE lowered callable per ``(kind, graph, fetches,
+feeds)`` key, but ``jax.jit`` still re-specializes (full XLA compile) for
+every distinct concrete BLOCK SHAPE it sees — so uneven ``repartition``
+remainders, filtered frames, and variable-size `reduce_blocks_stream`
+chunks each pay full compile latency, which on TPU dwarfs the per-block
+compute. A long-lived process whose block sizes drift is a recompile
+storm the cache counters cannot even see (the same class of problem the
+aggregate planner already solved for group sizes with its pow2 chunk
+decomposition, and `_run_ragged_bucketed` solved for ragged cells).
+
+This module is the block-level shape policy: every block feed is padded
+up to a geometric row-bucket ladder (``config.shape_bucket_min`` *
+``config.shape_bucket_growth``^k) by REPLICATING the last valid row, the
+bucketed program executes, and the padding is removed semantically:
+
+- map verbs slice the padded rows off every output (safe exactly when
+  every fetch is a row-local transform — `rowwise_fetches` proves it
+  with the same conservative op walk the aggregate chunk planner uses;
+  anything else runs the ordinary unbucketed dispatch);
+- per-block reduce stages mask the padded rows to the reduction
+  identity at the TRANSFORM OUTPUT (sum→0, prod→1, min/max→±inf /
+  integer extrema, mean via masked sum / true row count), so
+  ``Sum(exp(x))`` stays exact — masking the *input* would feed
+  ``exp(0)=1`` per pad row into the sum. Only graphs the structural
+  classifier (`aggregate._chunk_combiners`) proves reducible this way
+  are bucketed; the rest keep the exact unbucketed program.
+
+Compile count per graph drops from O(#distinct block sizes) to
+O(log_growth max-block-rows). Replicating the last row (instead of
+zero-fill) keeps pad rows numerically ordinary, so ``check_numerics``
+and non-total ops (Log, Reciprocal, ...) never see synthetic poison.
+
+Exactness: map outputs, min/max, and integer-dtype reductions are
+bit-identical to unbucketed eager execution. Float sum/mean reduce over
+a wider (padded) axis, so XLA's vectorized accumulation may group the
+REAL elements differently — the identical reassociation tolerance the
+repo already documents for `_aggregate_segment` and for stacking block
+partials; integer-valued float data stays bit-exact. Disable with
+``config.update(shape_bucketing=False)`` when exact FP accumulation
+order matters more than bounded compiles.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .aggregate import _chunk_combiners, _rowwise_transform
+from .graph.ir import Graph, base_name as _base
+from .ops.lowering import build_callable
+
+__all__ = [
+    "bucket_for",
+    "bucket_ladder",
+    "enabled",
+    "pad_feeds",
+    "pad_lead",
+    "slice_pad_rows",
+    "rowwise_fetches",
+    "MaskPlan",
+    "masked_reduce_plan",
+    "fused_mask_plan",
+    "build_masked_reduce",
+    "masked_callable",
+    "dispatch_masked",
+]
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+
+def bucket_for(
+    n: int,
+    growth: Optional[float] = None,
+    min_bucket: Optional[int] = None,
+) -> int:
+    """Smallest ladder rung >= ``n``: ``min_bucket * growth^k`` rounded
+    up to an int (each rung strictly larger than the last, so the
+    ladder is finite for any growth > 1). ``n <= 0`` maps to 0 — empty
+    blocks are never dispatched, bucketed or not."""
+    from . import config as _config
+
+    cfg = _config.get()
+    g = float(growth if growth is not None else cfg.shape_bucket_growth)
+    b = int(min_bucket if min_bucket is not None else cfg.shape_bucket_min)
+    if g <= 1.0:
+        raise ValueError(f"shape_bucket_growth must be > 1, got {g}")
+    if b < 1:
+        raise ValueError(f"shape_bucket_min must be >= 1, got {b}")
+    if n <= 0:
+        return 0
+    while b < n:
+        b = max(b + 1, int(-(-b * g // 1)))  # ceil(b * g), monotone
+    return b
+
+
+def bucket_ladder(
+    max_rows: int,
+    growth: Optional[float] = None,
+    min_bucket: Optional[int] = None,
+) -> List[int]:
+    """The distinct rungs covering block sizes 1..max_rows — the bound
+    on compiled shape specializations per program (benchmarks and tests
+    assert against its length)."""
+    rungs: List[int] = []
+    n = 1
+    while n <= max_rows:
+        r = bucket_for(n, growth, min_bucket)
+        rungs.append(r)
+        n = r + 1
+    return rungs
+
+
+def enabled(executor=None) -> bool:
+    """Bucketing is on for this dispatch: the config knob is set AND the
+    executor opts in (`supports_bucketing`; both the in-process and the
+    native executor do — the native host's per-shape-signature compile
+    cache benefits identically)."""
+    from . import config as _config
+
+    if not _config.get().shape_bucketing:
+        return False
+    return executor is None or getattr(executor, "supports_bucketing", False)
+
+
+# ---------------------------------------------------------------------------
+# padding
+# ---------------------------------------------------------------------------
+
+
+def pad_lead(a, n: int, bucket: int):
+    """Pad ``a``'s lead dim from ``n`` to ``bucket`` rows by replicating
+    the last valid row (numerically ordinary pad rows — see module
+    docstring). Device arrays pad with jnp (async, stays on device);
+    host arrays with numpy."""
+    if bucket <= n:
+        return a
+    import jax
+
+    rep = (bucket - n,) + tuple(a.shape[1:])
+    if isinstance(a, jax.Array):
+        import jax.numpy as jnp
+
+        return jnp.concatenate([a, jnp.broadcast_to(a[-1:], rep)])
+    a = np.asarray(a)
+    return np.concatenate([a, np.broadcast_to(a[-1:], rep)])
+
+
+def pad_feeds(feeds: Sequence, n: int) -> Tuple[List, int]:
+    """Pad every feed's lead dim up to ``n``'s bucket. Returns
+    ``(padded_feeds, bucket)``; when ``bucket == n`` the feeds pass
+    through untouched (the already-on-a-rung fast path)."""
+    b = bucket_for(n)
+    if b == n:
+        return list(feeds), n
+    from .utils.profiling import count as _count
+
+    _count("shape_bucketing.padded_dispatch")
+    return [pad_lead(f, n, b) for f in feeds], b
+
+
+def mesh_shard_plan(nrows: int, ndev: int):
+    """Rung size + per-shard valid row counts for splitting ``nrows``
+    into ``ndev`` contiguous bucket-rung shards — pure arithmetic, no
+    data movement, so callers can decide ELIGIBILITY (e.g. the all-pad-
+    shard gate in the mesh reduce) before paying for padded copies.
+    ``valids[d]`` is 0 for shards that would be pure padding."""
+    s = bucket_for(-(-nrows // ndev))
+    valids = np.clip(nrows - s * np.arange(ndev), 0, s).astype(np.int32)
+    return s, valids
+
+
+def pad_mesh_shards(frame, cols_used: Sequence[str], ndev: int):
+    """THE mesh padding recipe every bucketed `shard_map` verb shares:
+    pad each used column so the frame splits into ``ndev`` contiguous
+    shards of exactly one bucket rung (`mesh_shard_plan`) — `shard_map`
+    then sees ONE static shape per rung and the varying ``rows % ndev``
+    remainder-tail program disappears. Returns ``(main, tail,
+    shard_rows, shard_valids)``; ``tail`` is empty by construction."""
+    s, valids = mesh_shard_plan(frame.nrows, ndev)
+    main = {
+        c: pad_lead(frame.column(c).values, frame.nrows, s * ndev)
+        for c in set(cols_used)
+    }
+    tail = {c: main[c][:0] for c in main}
+    return main, tail, s, valids
+
+
+def slice_pad_rows(outs: Sequence, n: int, bucket: int) -> List:
+    """Slice the pad rows back off a padded map dispatch's outputs (lazy
+    device slices). An output that did not preserve the padded lead dim
+    is returned untouched, so the caller's row-count validation can name
+    it instead of a slice masking the contract violation."""
+    if bucket == n:
+        return list(outs)
+    return [
+        o[:n] if getattr(o, "ndim", 0) and o.shape[0] == bucket else o
+        for o in outs
+    ]
+
+
+# ---------------------------------------------------------------------------
+# map-safety classification (row-local graphs)
+# ---------------------------------------------------------------------------
+
+
+def rowwise_fetches(
+    graph: Graph, fetches: Sequence[str], ph_ranks: Dict[str, int]
+) -> bool:
+    """True when every fetch is a row-local function of the placeholders:
+    output row i depends only on input rows i (and on sub-lead-rank
+    constants), so pad rows cannot perturb valid rows and slicing the
+    output is a faithful inverse of padding the input. Delegates to the
+    ONE shared walk (`aggregate._rowwise_transform` — the same check
+    the chunk planner runs on reduce transforms), so map-bucketing
+    eligibility cannot diverge from reduce-chunk eligibility. Anything
+    unrecognized (reductions, matmuls, reshapes, control flow)
+    conservatively disqualifies the graph; it simply runs unbucketed."""
+    return _rowwise_transform(graph, list(fetches), ph_ranks.get)
+
+
+# ---------------------------------------------------------------------------
+# masked per-block reduce
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MaskPlan:
+    """Per-fetch recipe for the masked bucketed reduce program: the edge
+    feeding each root reduce node (the rowwise-transform output) and the
+    reduction's monoid tag."""
+
+    roots: Tuple[str, ...]
+    combiners: Tuple[str, ...]
+
+
+def _root_edge(graph: Graph, fetch: str) -> str:
+    src, idx = graph[_base(fetch)].data_inputs()[0]
+    return f"{src}:{idx}" if idx else src
+
+
+def masked_reduce_plan(
+    graph: Graph, fetch_list: Sequence[str], summary
+) -> Optional[MaskPlan]:
+    """Classify a reduce graph for bucketed execution. Piggybacks on the
+    aggregate chunk classifier: every fetch must be a recognized monoid
+    reduce (Sum/Min/Max/Prod, float Mean) over the lead axis of a
+    row-local transform of its placeholder. Returns None (→ run the
+    exact unbucketed program) otherwise."""
+    combs = _chunk_combiners(graph, list(fetch_list), summary)
+    if combs is None:
+        return None
+    return MaskPlan(
+        tuple(_root_edge(graph, f) for f in fetch_list),
+        tuple(combs[_base(f)] for f in fetch_list),
+    )
+
+
+def fused_mask_plan(
+    fused_graph: Graph,
+    fused_fetches: Sequence[str],
+    combiners: Sequence[str],
+    ph_ranks: Dict[str, int],
+) -> Optional[MaskPlan]:
+    """Mask plan for a FUSED lazy chain ending in a classified reduce:
+    the reduce classification ran on the plain reduce graph, but in the
+    fused graph each reduce root consumes the whole pending map chain —
+    masking at that root is only valid when the chain is row-local, so
+    the walk re-runs over the fused graph."""
+    roots = [_root_edge(fused_graph, f) for f in fused_fetches]
+    if not rowwise_fetches(fused_graph, roots, ph_ranks):
+        return None
+    return MaskPlan(tuple(roots), tuple(combiners))
+
+
+def _mask_identity(comb: str, dtype):
+    """The reduction identity pad rows mask to, dtype-aware (floats get
+    ±inf for min/max, integers their extrema, bools the monoid unit)."""
+    if comb in ("sum", "mean"):
+        return np.zeros((), dtype)
+    if comb == "prod":
+        return np.ones((), dtype)
+    dt = np.dtype(dtype)
+    if comb == "min":
+        if dt.kind == "b":
+            return np.ones((), dt)  # True: the AND/min identity
+        if dt.kind in ("i", "u"):
+            return np.asarray(np.iinfo(dt).max, dt)
+        return np.asarray(np.inf, dt)
+    if comb == "max":
+        if dt.kind == "b":
+            return np.zeros((), dt)
+        if dt.kind in ("i", "u"):
+            return np.asarray(np.iinfo(dt).min, dt)
+        return np.asarray(-np.inf, dt)
+    raise AssertionError(f"unknown combiner {comb!r}")
+
+
+def build_masked_reduce(
+    graph: Graph, plan: MaskPlan, feed_names: Sequence[str]
+):
+    """Build ``fn(valid, *feeds) -> tuple(partials)``: run the rowwise
+    transforms on the (padded) block, mask rows >= ``valid`` to each
+    fetch's reduction identity, reduce over the lead axis. ``valid`` is
+    a traced scalar, so ONE compiled program serves every true row count
+    within a bucket. The reductions mirror the eager lowerings
+    (`ops.standard`): Sum/Prod keep the input dtype, Mean divides the
+    masked sum by the true count (the classifier already rejected
+    integer Mean)."""
+    raw = build_callable(graph, list(plan.roots), list(feed_names))
+    combiners = plan.combiners
+
+    def fn(valid, *feeds):
+        import jax.numpy as jnp
+
+        valid = jnp.asarray(valid).reshape(())  # shard callers pass (1,)
+        outs = raw(*feeds)
+        res = []
+        for comb, o in zip(combiners, outs):
+            o = jnp.asarray(o)
+            m = (jnp.arange(o.shape[0]) < valid).reshape(
+                (-1,) + (1,) * (o.ndim - 1)
+            )
+            masked = jnp.where(m, o, _mask_identity(comb, o.dtype))
+            if comb == "sum":
+                res.append(jnp.sum(masked, axis=0, dtype=o.dtype))
+            elif comb == "mean":
+                s = jnp.sum(masked, axis=0, dtype=o.dtype)
+                # multiply by the reciprocal, NOT a true divide: the
+                # eager `jnp.mean` divides by a compile-time constant
+                # count, which XLA strength-reduces to multiplication by
+                # the rounded reciprocal — reproducing that keeps masked
+                # means bit-identical to eager ones
+                res.append(
+                    s * (jnp.asarray(1.0, o.dtype) / jnp.asarray(valid, o.dtype))
+                )
+            elif comb == "prod":
+                res.append(jnp.prod(masked, axis=0, dtype=o.dtype))
+            elif comb == "min":
+                res.append(jnp.min(masked, axis=0))
+            else:
+                res.append(jnp.max(masked, axis=0))
+        return tuple(res)
+
+    return fn
+
+
+def masked_callable(ex, graph: Graph, fetch_list, feed_names, plan: MaskPlan):
+    """THE "block-bucketed" program constructor — every masked dispatch
+    site (eager reduce_blocks, the fused lazy reduce terminal, the mesh
+    reduce tail) goes through here so the cache kind, key components and
+    calling convention stay identical by construction: that is what lets
+    e.g. the mesh tail share the local verb's compiled entry."""
+    import jax
+
+    return ex.cached(
+        "block-bucketed",
+        graph,
+        list(fetch_list),
+        list(feed_names),
+        lambda: jax.jit(build_masked_reduce(graph, plan, feed_names)),
+    )
+
+
+def dispatch_masked(fn, feeds: Sequence, n: int):
+    """Run a masked bucketed program on one block: pad the feeds to the
+    ladder and pass the true row count as the traced ``valid`` scalar."""
+    feeds, _ = pad_feeds(feeds, n)
+    return fn(np.int32(n), *feeds)

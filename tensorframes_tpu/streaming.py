@@ -236,6 +236,15 @@ def reduce_blocks_stream(
 
     partials: List[Dict] = []
     for f in _prefetch_iter(frames, stage=stage):
+        nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
+        if nrows == 0:
+            # Empty chunk (empty file partition / fully filtered shard):
+            # it contributes the reduction identity, i.e. nothing — skip
+            # the dispatch instead of raising "empty frame" mid-stream or
+            # emitting a partial that poisons the combine (reduce_min
+            # over 0 rows). Classification (auto_fold) waits for the
+            # first chunk that actually carries rows.
+            continue
         if auto_fold:
             # classify once, on the first chunk: tree-fold only graphs
             # proven associative (sum/min/max/prod monoids); anything
@@ -272,7 +281,10 @@ def reduce_blocks_stream(
                 k: np.asarray(v) for k, v in partials[-2].items()
             }
     if not partials:
-        raise ValueError("reduce_blocks_stream over an empty iterator")
+        raise ValueError(
+            "reduce_blocks_stream over an empty iterator (or every chunk "
+            "had zero rows)"
+        )
     out = partials[0] if len(partials) == 1 else _combine(partials)
     if len(fetch_list) == 1:
         return out[_base(fetch_list[0])]

@@ -75,18 +75,27 @@ def executor_stats(executor=None) -> Dict[str, int]:
     """Compile-cache observability for an executor (the process default
     when none is given): ``compile_count`` (distinct lowered programs),
     ``cache_hits`` / ``cache_misses`` (per `Executor.cached` lookup),
-    and ``cache_entries`` (live LRU size). A recompile storm — shape or
-    graph churn defeating the cache — shows up as misses growing with
-    call count while hits stall; pair with `cost_analysis` to see what
-    each recompile costs."""
+    ``cache_entries`` (live LRU size), and ``jit_shape_compiles`` — the
+    REAL XLA compile count: jit re-specializes each cached program per
+    distinct input shape signature, invisibly to ``compile_count``, so a
+    shape-churn recompile storm shows up ONLY here (growing with call
+    count while cache_misses stall). Under ``config.shape_bucketing``
+    it stays O(log max-block-rows) per program; pair with
+    `cost_analysis` to see what each recompile costs."""
     from ..runtime.executor import default_executor
 
     ex = executor if executor is not None else default_executor()
+    shape_compiles = getattr(ex, "jit_shape_compiles", None)
     return {
         "compile_count": int(getattr(ex, "compile_count", 0)),
         "cache_hits": int(getattr(ex, "cache_hits", 0)),
         "cache_misses": int(getattr(ex, "cache_misses", 0)),
         "cache_entries": len(getattr(ex, "_cache", ())),
+        "jit_shape_compiles": (
+            int(shape_compiles())
+            if callable(shape_compiles)
+            else int(getattr(ex, "compile_count", 0))
+        ),
     }
 
 

@@ -1,0 +1,513 @@
+"""Shape-bucketed block execution (ISSUE 3): bounded XLA recompiles.
+
+The contract under test: with `config.shape_bucketing` on (the default),
+any workload's distinct compiled SHAPES per program stay on the bucket
+ladder — O(log max-block-rows) — no matter how block sizes drift, and
+results match unbucketed eager execution (bit-identical for map outputs,
+min/max, integer dtypes, and integer-valued float data; the documented
+FP-reassociation tolerance otherwise). Graphs the classifiers cannot
+prove safe (non-row-local maps, non-monoid reduces) run the exact
+unbucketed dispatch regardless of the knob.
+"""
+
+import logging
+import math
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu import shape_policy as sp
+from tensorframes_tpu.runtime.executor import Executor
+from tensorframes_tpu.utils.inspection import executor_stats
+
+
+def _uneven(sizes, mod=13, dtype=np.float32):
+    """One float column with integer-valued data (order-independent-exact
+    FP sums) split into blocks of the given sizes."""
+    n = int(sum(sizes))
+    offsets = list(np.cumsum([0] + list(sizes)))
+    df = tfs.TensorFrame.from_dict({"x": (np.arange(n) % mod).astype(dtype)})
+    return tfs.TensorFrame([df["x"]], offsets)
+
+
+def _reduce(df_like, op, col="x"):
+    ph = tfs.block(df_like, col, tf_name=col + "_input")
+    return {
+        "sum": dsl.reduce_sum,
+        "min": dsl.reduce_min,
+        "max": dsl.reduce_max,
+        "mean": dsl.reduce_mean,
+    }[op](ph, axes=[0]).named(col)
+
+
+class TestBucketLadder:
+    def test_ladder_is_geometric_and_monotone(self):
+        with tfs.config.override(shape_bucket_growth=2.0, shape_bucket_min=8):
+            assert sp.bucket_for(0) == 0
+            assert sp.bucket_for(1) == 8
+            assert sp.bucket_for(8) == 8
+            assert sp.bucket_for(9) == 16
+            assert sp.bucket_for(1000) == 1024
+            ladder = sp.bucket_ladder(1000)
+            assert ladder == [8, 16, 32, 64, 128, 256, 512, 1024]
+
+    def test_growth_factor_configurable(self):
+        with tfs.config.override(shape_bucket_growth=4.0, shape_bucket_min=4):
+            assert sp.bucket_ladder(200) == [4, 16, 64, 256]
+        with tfs.config.override(shape_bucket_growth=1.5, shape_bucket_min=8):
+            ladder = sp.bucket_ladder(100)
+            assert ladder[0] == 8 and ladder[-1] >= 100
+            assert all(b < a for b, a in zip(ladder, ladder[1:]))
+
+    def test_bad_geometry_raises(self):
+        with tfs.config.override(shape_bucket_growth=1.0):
+            with pytest.raises(ValueError, match="shape_bucket_growth"):
+                sp.bucket_for(5)
+        with tfs.config.override(shape_bucket_min=0):
+            with pytest.raises(ValueError, match="shape_bucket_min"):
+                sp.bucket_for(5)
+
+    def test_frame_bucketed_block_sizes(self):
+        df = _uneven([5, 0, 12, 40])
+        with tfs.config.override(shape_bucket_growth=2.0, shape_bucket_min=8):
+            assert df.bucketed_block_sizes() == [8, 0, 16, 64]
+        assert df.block_sizes() == [5, 0, 12, 40]
+
+
+class TestBucketedMap:
+    def test_map_bit_identical_and_bounded_compiles(self):
+        sizes = [3, 9, 17, 31, 64, 101, 7, 55]  # 8 distinct sizes
+        df = _uneven(sizes)
+        ex = Executor()
+        out = tfs.map_blocks(
+            (tfs.block(df, "x") * 2.0 + 1.0).named("y"), df, executor=ex
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["y"].values), df["x"].values * 2.0 + 1.0
+        )
+        # one "block" program, shapes quantized to the ladder
+        rungs = len(set(df.bucketed_block_sizes()))
+        assert ex.jit_shape_compiles() <= rungs
+        assert rungs < len(set(sizes))
+
+    def test_map_unbucketed_compiles_one_per_size(self):
+        sizes = [3, 9, 17, 31, 64, 101, 7, 55]
+        df = _uneven(sizes)
+        with tfs.config.override(shape_bucketing=False):
+            ex = Executor()
+            tfs.map_blocks(
+                (tfs.block(df, "x") * 2.0 + 1.0).named("y"), df, executor=ex
+            )
+            assert ex.jit_shape_compiles() == len(set(sizes))
+
+    def test_non_rowwise_map_not_bucketed_and_exact(self):
+        # y = x - mean(x) depends on the WHOLE block: padding would
+        # corrupt valid rows, so the classifier must refuse it
+        df = _uneven([5, 12, 20])
+        x = tfs.block(df, "x")
+        y = (x - dsl.reduce_mean(x, axes=[0])).named("y")
+        ex = Executor()
+        out = tfs.map_blocks(y, df, executor=ex)
+        want = np.concatenate(
+            [
+                df["x"].values[lo:hi] - df["x"].values[lo:hi].mean()
+                for lo, hi in zip(df.offsets, df.offsets[1:])
+            ]
+        )
+        np.testing.assert_allclose(np.asarray(out["y"].values), want, rtol=1e-5)
+        # unbucketed: one jit specialization per distinct block size
+        assert ex.jit_shape_compiles() == 3
+
+    def test_rowwise_classifier(self):
+        df = _uneven([4, 4])
+        g1, f1 = dsl.build((tfs.block(df, "x") * 2.0).named("y"))
+        from tensorframes_tpu.graph.analysis import analyze_graph
+
+        s1 = analyze_graph(g1, f1)
+        ranks = {p: ph.shape.rank for p, ph in s1.inputs.items()}
+        assert sp.rowwise_fetches(g1, f1, ranks)
+        x = tfs.block(df, "x")
+        g2, f2 = dsl.build(dsl.reduce_sum(x, axes=[0]).named("y"))
+        s2 = analyze_graph(g2, f2)
+        ranks2 = {p: ph.shape.rank for p, ph in s2.inputs.items()}
+        assert not sp.rowwise_fetches(g2, f2, ranks2)
+
+
+class TestBucketedReduce:
+    @pytest.mark.parametrize("op", ["sum", "min", "max", "mean"])
+    def test_reduce_matches_unbucketed(self, op):
+        df = _uneven([3, 9, 17, 31, 64, 101, 7, 55])
+        r_on = tfs.reduce_blocks(_reduce(df, op), df, executor=Executor())
+        with tfs.config.override(shape_bucketing=False):
+            r_off = tfs.reduce_blocks(_reduce(df, op), df, executor=Executor())
+        # integer-valued float32 data: exact under any accumulation order
+        assert np.asarray(r_on) == np.asarray(r_off)
+
+    def test_reduce_int_dtypes_exact(self):
+        sizes = [5, 12, 33]
+        n = sum(sizes)
+        df = tfs.TensorFrame(
+            [
+                tfs.TensorFrame.from_dict(
+                    {"x": (np.arange(n) % 19).astype(np.int32)}
+                )["x"]
+            ],
+            list(np.cumsum([0] + sizes)),
+        )
+        for op in ("sum", "min", "max"):
+            r = tfs.reduce_blocks(_reduce(df, op), df, executor=Executor())
+            with tfs.config.override(shape_bucketing=False):
+                r0 = tfs.reduce_blocks(_reduce(df, op), df, executor=Executor())
+            assert np.asarray(r) == np.asarray(r0)
+
+    def test_transform_then_reduce_masks_at_root(self):
+        # Sum(x^2 + 1): each pad row (a replica of the last real row)
+        # would contribute last^2 + 1 to the sum unless the mask applies
+        # at the transform OUTPUT — masking the input to 0 would still
+        # leak +1 per pad row
+        # single block (no combine: reduce_blocks re-applies the graph to
+        # partials by contract, which would square them again): 5 rows
+        # pad to the 8-rung — an input-level mask would leak 3 * 1.0
+        df = _uneven([5])
+        ph = tfs.block(df, "x", tf_name="x_input")
+        fetch = dsl.reduce_sum(dsl.square(ph) + 1.0, axes=[0]).named("x")
+        r = tfs.reduce_blocks(fetch, df, executor=Executor())
+        want = float((df["x"].values.astype(np.float64) ** 2 + 1.0).sum())
+        assert float(np.asarray(r)) == want
+        # multi-block: bucketed and unbucketed agree through the combine
+        df2 = _uneven([5, 13])
+        r2 = tfs.reduce_blocks(fetch, df2, executor=Executor())
+        with tfs.config.override(shape_bucketing=False):
+            r0 = tfs.reduce_blocks(fetch, df2, executor=Executor())
+        assert np.asarray(r2) == np.asarray(r0)
+
+    def test_reduce_compile_count_bounded(self):
+        sizes = list(range(1, 65))  # 64 distinct block sizes
+        df = _uneven(sizes)
+        ex = Executor()
+        tfs.reduce_blocks(_reduce(df, "sum"), df, executor=ex)
+        rungs = len(set(b for b in df.bucketed_block_sizes() if b))
+        # the per-block program compiles one shape per rung; the combine
+        # adds one more program/shape
+        assert ex.jit_shape_compiles() <= rungs + 1
+        assert rungs <= math.ceil(math.log2(max(sizes))) + 1
+
+    def test_multi_fetch_ordering_preserved(self):
+        # x/n fetches sort differently as feeds (n_input, x_input) —
+        # the masked program must keep fetch->result alignment
+        df = _uneven([5, 9])
+        ncol = tfs.TensorFrame.from_dict(
+            {"n": np.ones(df.nrows, np.float32)}
+        )["n"]
+        df2 = tfs.TensorFrame([df["x"], ncol], df.offsets)
+        fx = _reduce(df2, "sum", "x")
+        fn_ = _reduce(df2, "sum", "n")
+        out = tfs.reduce_blocks([fx, fn_], df2, executor=Executor())
+        assert float(np.asarray(out["x"])) == float(df2["x"].values.sum())
+        assert float(np.asarray(out["n"])) == float(df2.nrows)
+
+    def test_unclassifiable_reduce_unbucketed(self):
+        # integer Mean truncates per block (TF semantics), so partials
+        # cannot recombine exactly — the classifier refuses it and the
+        # verb keeps the exact unbucketed program
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1, 2, 3, 4, 11], np.int32)}
+        )
+        ph = tfs.block(df, "x", tf_name="x_input")
+        fetch = dsl.reduce_mean(ph, axes=[0]).named("x")
+        ex = Executor()
+        r = tfs.reduce_blocks(fetch, df, executor=ex)
+        assert int(np.asarray(r)) == 21 // 5
+        assert all(k[0] != "block-bucketed" for k in ex.cache_keys())
+
+
+class TestEmptyBlocks:
+    def test_repartition_beyond_nrows_reduce_min(self):
+        # regression (ISSUE 3 satellite): zero-row blocks must never
+        # dispatch — a padded all-pad block would emit +inf partials
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([3.0, 1.0, 2.0], np.float32)}
+        ).repartition(8)
+        assert 0 in df.block_sizes()
+        for op, want in (("min", 1.0), ("max", 3.0), ("sum", 6.0)):
+            r = tfs.reduce_blocks(_reduce(df, op), df, executor=Executor())
+            assert float(np.asarray(r)) == want
+
+    def test_lazy_fused_reduce_skips_empty_blocks(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([3.0, 1.0, 2.0], np.float32)}
+        ).repartition(6)
+        lf = df.lazy().map_blocks((tfs.block(df, "x") * 2.0).named("y"))
+        r = lf.reduce_blocks(_reduce(lf, "min", "y"))
+        assert float(np.asarray(r)) == 2.0
+
+
+class TestStreaming:
+    def _fetch(self):
+        first = tfs.TensorFrame.from_dict({"x": np.zeros(1, np.float32)})
+        return _reduce(first, "sum")
+
+    def test_varying_chunks_bounded_compiles_and_identical(self):
+        sizes = [17, 33, 5, 64, 12, 100, 41, 9, 77, 28]
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": (np.arange(n) % 7).astype(np.float32)}
+            )
+            for n in sizes
+        ]
+        ex = Executor()
+        r = tfs.reduce_blocks_stream(self._fetch(), iter(chunks), executor=ex)
+        with tfs.config.override(shape_bucketing=False):
+            r0 = tfs.reduce_blocks_stream(
+                self._fetch(), iter(chunks), executor=Executor()
+            )
+        assert np.asarray(r) == np.asarray(r0)
+        rungs = len({sp.bucket_for(n) for n in sizes})
+        # per-chunk programs on the ladder + one final combine program
+        assert ex.jit_shape_compiles() <= rungs + 1
+        assert rungs < len(set(sizes))
+
+    def test_lazy_chunks_stream_bucketed(self):
+        sizes = [11, 29, 53]
+        def chunks():
+            for n in sizes:
+                c = tfs.TensorFrame.from_dict(
+                    {"x": (np.arange(n) % 5).astype(np.float32)}
+                )
+                yield c.lazy().map_blocks((tfs.block(c, "x") * 2.0).named("y"))
+        first = tfs.TensorFrame.from_dict({"y": np.zeros(1, np.float32)})
+        fetch = _reduce(first, "sum", "y")
+        ex = Executor()
+        r = tfs.reduce_blocks_stream(fetch, chunks(), executor=ex)
+        want = sum(2.0 * float((np.arange(n) % 5).sum()) for n in sizes)
+        assert float(np.asarray(r)) == want
+        kinds = {k[0] for k in ex.cache_keys()}
+        assert "block-bucketed" in kinds
+
+    def test_empty_chunk_skipped(self):
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": (np.arange(n) % 7).astype(np.float32)}
+            )
+            for n in (9, 0, 21)
+        ]
+        r = tfs.reduce_blocks_stream(self._fetch(), iter(chunks))
+        want = float((np.arange(9) % 7).sum() + (np.arange(21) % 7).sum())
+        assert float(np.asarray(r)) == want
+
+    def test_empty_pandas_chunk_skipped(self):
+        pd = pytest.importorskip("pandas")
+        chunks = [
+            pd.DataFrame({"x": (np.arange(n) % 7).astype(np.float32)})
+            for n in (4, 0, 3)
+        ]
+        r = tfs.reduce_blocks_stream(self._fetch(), iter(chunks))
+        want = float((np.arange(4) % 7).sum() + (np.arange(3) % 7).sum())
+        assert float(np.asarray(r)) == want
+
+    def test_all_empty_stream_raises(self):
+        chunks = [tfs.TensorFrame.from_dict({"x": np.zeros(0, np.float32)})]
+        with pytest.raises(ValueError, match="zero rows"):
+            tfs.reduce_blocks_stream(self._fetch(), iter(chunks))
+
+
+class TestLazyFusion:
+    def test_fused_chain_bucketed_matches_eager(self):
+        df = _uneven([7, 19, 40, 13])
+        ex = Executor()
+        lf = df.lazy()
+        lf = lf.map_blocks(
+            (tfs.block(lf, "x") * 2.0 + 1.0).named("y"), executor=ex
+        )
+        r = lf.reduce_blocks(_reduce(lf, "sum", "y"), executor=ex)
+        with tfs.config.override(shape_bucketing=False):
+            ex0 = Executor()
+            lf0 = df.lazy()
+            lf0 = lf0.map_blocks(
+                (tfs.block(lf0, "x") * 2.0 + 1.0).named("y"), executor=ex0
+            )
+            r0 = lf0.reduce_blocks(_reduce(lf0, "sum", "y"), executor=ex0)
+        assert np.asarray(r) == np.asarray(r0)
+        # whole chain = ONE bucketed per-block program + one combine
+        from collections import Counter
+
+        kinds = Counter(k[0] for k in ex.cache_keys())
+        assert kinds["block-bucketed"] == 1
+        assert kinds["block"] == 0
+
+    def test_forced_map_plan_bucketed_bit_identical(self):
+        df = _uneven([7, 19, 40, 13])
+        ex = Executor()
+        lf = df.lazy().map_blocks(
+            (tfs.block(df, "x") * 3.0).named("z"), executor=ex
+        )
+        out = lf.force()
+        np.testing.assert_array_equal(
+            np.asarray(out["z"].values), df["x"].values * 3.0
+        )
+        assert ex.jit_shape_compiles() <= len(
+            set(b for b in df.bucketed_block_sizes() if b)
+        )
+
+
+class TestObservability:
+    def test_executor_stats_has_shape_compiles(self):
+        ex = Executor()
+        df = _uneven([5, 12])
+        tfs.map_blocks((tfs.block(df, "x") * 2.0).named("y"), df, executor=ex)
+        s = executor_stats(ex)
+        assert s["jit_shape_compiles"] >= s["compile_count"] >= 1
+        assert s["jit_shape_compiles"] == ex.jit_shape_compiles()
+
+    @staticmethod
+    def _capture_storms():
+        """The framework logger is propagate=False (utils.log), so caplog
+        cannot see it — attach a recording handler directly."""
+        records = []
+
+        class _H(logging.Handler):
+            def emit(self, record):
+                if "recompile storm" in record.getMessage():
+                    records.append(record)
+
+        logger = logging.getLogger("tensorframes_tpu.executor")
+        h = _H(level=logging.WARNING)
+        logger.addHandler(h)
+        return records, lambda: logger.removeHandler(h)
+
+    def _drift(self, ex):
+        for n in (10, 20, 30, 40, 50, 60, 70):
+            df = tfs.TensorFrame.from_dict(
+                {"x": np.arange(n, dtype=np.float32)}
+            )
+            tfs.map_blocks(
+                (tfs.block(df, "x") * 2.0).named("y"), df, executor=ex
+            )
+
+    def test_recompile_storm_warns_once(self):
+        records, detach = self._capture_storms()
+        try:
+            with tfs.config.override(
+                shape_bucketing=False, recompile_warn_shapes=3
+            ):
+                self._drift(Executor())
+        finally:
+            detach()
+        assert len(records) == 1  # one warning per program, ever
+
+    def test_bucketing_quells_the_storm(self):
+        records, detach = self._capture_storms()
+        try:
+            with tfs.config.override(recompile_warn_shapes=4):
+                ex = Executor()
+                self._drift(ex)
+        finally:
+            detach()
+        assert not records
+        assert ex.jit_shape_compiles() <= 4  # ladder rungs for 10..70
+
+
+class TestMeshBucketing:
+    def _mesh(self):
+        import jax
+
+        try:
+            from tensorframes_tpu.parallel import data_mesh
+        except Exception as e:  # jax pin without jax.shard_map
+            pytest.skip(f"mesh layer unavailable: {e}")
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the virtual multi-device CPU mesh")
+        return data_mesh()
+
+    def test_mesh_map_pads_to_uniform_shards(self):
+        mesh = self._mesh()
+        # nrows deliberately NOT divisible by ndev: unbucketed this would
+        # run a main shard program + a remainder tail program
+        df = tfs.TensorFrame.from_dict(
+            {"x": (np.arange(103) % 11).astype(np.float32)}
+        )
+        ex = Executor()
+        out = tfs.map_blocks(
+            (tfs.block(df, "x") * 2.0 + 1.0).named("y"),
+            df,
+            mesh=mesh,
+            executor=ex,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["y"].values), df["x"].values * 2.0 + 1.0
+        )
+        # bucketed: ONE padded shard_map dispatch, no tail "block" entry
+        kinds = {k[0] for k in ex.cache_keys()}
+        assert not any(k == "block" for k in kinds)
+
+    def test_mesh_reduce_bucketed_shards_bounded_and_exact(self):
+        mesh = self._mesh()
+        ex = Executor()
+        # drifting nrows: unbucketed this compiles one shard_map shape
+        # per distinct nrows//ndev AND one tail shape per remainder
+        for n in (103, 217, 311, 409, 97, 530):
+            df = tfs.TensorFrame.from_dict(
+                {"x": (np.arange(n) % 11).astype(np.float32)}
+            )
+            for op, want in (("min", 0.0), ("sum", None)):
+                r = tfs.reduce_blocks(
+                    _reduce(df, op), df, mesh=mesh, executor=ex
+                )
+                if want is None:
+                    want = float((np.arange(n) % 11).sum())
+                assert float(np.asarray(r)) == want
+        rungs = len(
+            {sp.bucket_for(-(-n // mesh.devices.size))
+             for n in (103, 217, 311, 409, 97, 530)}
+        )
+        # two graphs (min/sum) x (sharded program + masked tail + the
+        # rare combine), each bounded to the ladder, not to #distinct n
+        assert ex.jit_shape_compiles() <= 2 * 3 * (rungs + 1)
+
+    def test_mesh_reduce_allpad_shard_indirect_transform_exact(self):
+        # nrows << ndev * rung forces all-pad shards; Max(Abs(x)) must
+        # NOT see a -inf identity re-transformed to +inf in the combine
+        # (indirect graphs fall back to unbucketed shards there)
+        mesh = self._mesh()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([2.0, 5.0, 3.0], np.float32)}
+        )
+        ph = tfs.block(df, "x", tf_name="x_input")
+        fetch = dsl.reduce_max(dsl.square(ph), axes=[0]).named("x")
+        r = tfs.reduce_blocks(fetch, df, mesh=mesh, executor=Executor())
+        with tfs.config.override(shape_bucketing=False):
+            r0 = tfs.reduce_blocks(
+                fetch, df, mesh=mesh, executor=Executor()
+            )
+        assert np.isfinite(np.asarray(r)).all()
+        assert np.asarray(r) == np.asarray(r0)
+
+    def test_mesh_reduce_mean_keeps_unbucketed_shards(self):
+        # Mean must NOT regroup shard boundaries (equal-weight partial
+        # combine); it keeps the plain sharded program + masked tail
+        mesh = self._mesh()
+        df = tfs.TensorFrame.from_dict(
+            {"x": (np.arange(103) % 11).astype(np.float32)}
+        )
+        ex = Executor()
+        r = tfs.reduce_blocks(_reduce(df, "mean"), df, mesh=mesh, executor=ex)
+        with tfs.config.override(shape_bucketing=False):
+            r0 = tfs.reduce_blocks(
+                _reduce(df, "mean"), df, mesh=mesh, executor=Executor()
+            )
+        assert np.asarray(r) == np.asarray(r0)
+        assert any(k[0].startswith("shred-") and "bkt" not in k[0]
+                   for k in ex.cache_keys())
+
+    def test_mesh_fused_force_bucketed(self):
+        mesh = self._mesh()
+        df = tfs.TensorFrame.from_dict(
+            {"x": (np.arange(103) % 11).astype(np.float32)}
+        )
+        lf = df.lazy().map_blocks((tfs.block(df, "x") * 3.0).named("z"))
+        out = lf.force(mesh=mesh)
+        np.testing.assert_array_equal(
+            np.asarray(out["z"].values), df["x"].values * 3.0
+        )

@@ -53,7 +53,9 @@ class TestAsyncDispatch:
         reset_stats()
         res = tfs.reduce_blocks(s, df, executor=ex)
         # all 5 block programs ran, then exactly one combine — in order
-        assert ex.events == ["block"] * 5 + ["reduce-combine"]
+        # the per-block reduce stage runs the masked bucketed program
+        # under the default shape policy ("block" with bucketing off)
+        assert ex.events == ["block-bucketed"] * 5 + ["reduce-combine"]
         # nothing crossed to the host during the verb...
         assert stats().get("host_sync", 0) == 0
         # ...because the result is still a device array
@@ -78,7 +80,7 @@ class TestAsyncDispatch:
         x_in = tfs.block(df, "x", tf_name="x_input")
         s = dsl.reduce_sum(x_in, axes=[0]).named("x")
         res = tfs.reduce_blocks(s, df, executor=ex)
-        assert ex.events == ["block"]
+        assert ex.events == ["block-bucketed"]
         assert float(np.asarray(res)) == float(np.arange(32.0).sum())
 
 
@@ -227,7 +229,8 @@ class TestExecutorCacheCounters:
     def test_stats_surface_defaults_to_process_executor(self):
         s = executor_stats()
         assert set(s) == {
-            "compile_count", "cache_hits", "cache_misses", "cache_entries"
+            "compile_count", "cache_hits", "cache_misses", "cache_entries",
+            "jit_shape_compiles",
         }
 
 

@@ -42,6 +42,9 @@ SMOKE_SIZES = {
     "PIPE_ROWS": "100000",
     "PIPE_BLOCKS": "4",
     "PIPE_ITERS": "3",
+    "TELE_ROWS": "100000",
+    "TELE_BLOCKS": "4",
+    "TELE_ITERS": "3",
     "FUSE_ROWS": "100000",
     "FUSE_BLOCKS": "4",
     "FUSE_ITERS": "3",
@@ -64,6 +67,7 @@ def main():
     for mod in (
         "convert_bench",
         "pipeline_bench",
+        "telemetry_bench",
         "fusion_bench",
         "bucketing_bench",
         "map_sum_bench",

@@ -53,6 +53,8 @@ from .runtime import Executor
 from . import config
 from . import io
 from . import utils
+from .utils import telemetry
+from .utils.telemetry import diagnostics
 
 __all__ = [
     "Column",
@@ -87,4 +89,6 @@ __all__ = [
     "ShapeHints",
     "dsl",
     "Executor",
+    "telemetry",
+    "diagnostics",
 ]

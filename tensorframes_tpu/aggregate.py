@@ -340,7 +340,16 @@ def _aggregate_segment(
         else np.zeros(0, np.int32)
     )
     feeds = [frame.column(mapping[n]).values for n in feed_names]
-    outs = sfn(gid, counts, *feeds)
+    from .utils import telemetry as _tele
+
+    with _tele.span(
+        "aggregate.plan.segment", kind="stage", program=graph.fingerprint()
+    ):
+        with _tele.dispatch_span(
+            "aggregate.segment", program=graph.fingerprint(),
+            rows=frame.nrows, groups=num_groups,
+        ):
+            outs = sfn(gid, counts, *feeds)
     maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
     # device-resident output: the per-group table stays where the
     # segment ops produced it; a chained verb (or host_values) decides
@@ -389,6 +398,7 @@ def _aggregate_chunked(
     bases: List[str],
     combiners: Dict[str, str],
     pad_quantum: int = 1,
+    program: Optional[str] = None,
 ) -> Dict[str, np.ndarray]:
     """Keyed aggregation by pow2 chunk decomposition + monoid combine.
 
@@ -441,6 +451,8 @@ def _aggregate_chunked(
     #    All chunk-size programs are DISPATCHED before any result is
     #    host-fetched (async device partials, same discipline as the
     #    reduce verbs); the scatter into the flat table then drains them.
+    from .utils import telemetry as _tele
+
     pending = []
     for p in sorted(chunk_starts_by_p, reverse=True):
         starts_list = chunk_starts_by_p[p]
@@ -449,7 +461,10 @@ def _aggregate_chunked(
         st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
         row_idx = st[:, None] + np.arange(p)[None, :]
         feeds = [col_data[n][row_idx] for n in feed_names]
-        outs = run(feeds)
+        with _tele.dispatch_span(
+            "aggregate.chunk", program=program, rows=n_p * p, size=p
+        ):
+            outs = run(feeds)
         maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
         pending.append((n_p, np.asarray(chunk_slots_by_p[p]), tuple(outs)))
     partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}

@@ -345,7 +345,15 @@ def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
         return jax.jit(combine)
 
     cfn = ex.cached(kind, graph, fetch_list, feed_names, make)
-    return tuple(cfn(tuple(partials)))
+    from .utils import telemetry as _tele
+
+    # rows stays unset: the combine consumes per-block PARTIALS, and a
+    # partial count in the block_rows histogram would skew the per-block
+    # row-size distribution the histogram documents
+    with _tele.dispatch_span(
+        kind, program=graph.fingerprint(), partials=len(partials)
+    ):
+        return tuple(cfn(tuple(partials)))
 
 
 def _concat_parts(parts: List) -> "np.ndarray":
@@ -651,6 +659,9 @@ def map_blocks(
         )
     )
 
+    from .utils import telemetry as _tele
+
+    fp = graph.fingerprint()
     acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
     out_sizes: List[int] = []
     for bi in range(frame.num_blocks):
@@ -675,11 +686,15 @@ def map_blocks(
         from . import config as _config
         from .runtime.retry import run_with_retries
 
-        outs = run_with_retries(
-            fn, *feeds,
-            attempts=_config.get().block_retry_attempts,
-            what=f"map_blocks block {bi}",
-        )
+        with _tele.dispatch_span(
+            "map_blocks.block", program=fp, block=bi, rows=hi - lo,
+            bucket=bucket if bucketed else None,
+        ):
+            outs = run_with_retries(
+                fn, *feeds,
+                attempts=_config.get().block_retry_attempts,
+                what=f"map_blocks block {bi}",
+            )
         outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
         maybe_check_numerics(fetch_list, outs, f"map_blocks block {bi}")
         bsize = None
@@ -1027,6 +1042,9 @@ def reduce_blocks(
     # `DataOps.scala:63-81`). maybe_check_numerics is a no-op unless the
     # debug mode is on, in which case it deliberately syncs per block to
     # name the offender.
+    from .utils import telemetry as _tele
+
+    fp = graph.fingerprint()
     partials: List[Tuple] = []
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -1036,10 +1054,14 @@ def reduce_blocks(
             # reduction identity (e.g. +inf for Min) and poison the combine
             continue
         feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
-        if mask_plan is not None:
-            outs = _sp.dispatch_masked(fn, feeds, hi - lo)
-        else:
-            outs = fn(*feeds)
+        with _tele.dispatch_span(
+            "reduce_blocks.block", program=fp, block=bi, rows=hi - lo,
+            masked=mask_plan is not None or None,
+        ):
+            if mask_plan is not None:
+                outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+            else:
+                outs = fn(*feeds)
         maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
         partials.append(tuple(outs))
     if not partials:
@@ -1186,6 +1208,9 @@ def reduce_rows(
     # async dispatch, device-resident partials: same discipline as
     # reduce_blocks — every block's fold is in flight before anything
     # is combined, and nothing is host-fetched on this path at all
+    from .utils import telemetry as _tele
+
+    fp = graph.fingerprint()
     partials: List[Tuple] = []
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -1195,7 +1220,10 @@ def reduce_rows(
         if hi - lo == 1:
             partials.append(tuple(cols[b][0] for b in bases))
         else:
-            outs = jfold(cols)
+            with _tele.dispatch_span(
+                "reduce_rows.block", program=fp, block=bi, rows=hi - lo
+            ):
+                outs = jfold(cols)
             maybe_check_numerics(bases, outs, f"reduce_rows block {bi}")
             partials.append(tuple(outs))
     if not partials:
@@ -1350,6 +1378,9 @@ def aggregate(
     _count(
         "aggregate.plan.exact" if combiners is None else "aggregate.plan.chunk"
     )
+    from .utils import telemetry as _tele
+
+    fp = graph.fingerprint()
     if combiners is None:
         # exact plan: one vmapped call per distinct size, whole groups —
         # no associativity assumption, best for regular key distributions.
@@ -1359,13 +1390,20 @@ def aggregate(
         # flight, so per-size device work overlaps instead of
         # serializing on each size's D2H copy.
         pending: List[Tuple[np.ndarray, Tuple]] = []
-        for size in unique_sizes:
-            gids = np.nonzero(counts == size)[0]
-            row_idx = starts[gids][:, None] + np.arange(size)[None, :]
-            feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
-            outs = vraw(*feeds)
-            maybe_check_numerics(bases, outs, f"aggregate groups of size {size}")
-            pending.append((gids, tuple(outs)))
+        with _tele.span("aggregate.plan.exact", kind="stage", program=fp):
+            for size in unique_sizes:
+                gids = np.nonzero(counts == size)[0]
+                row_idx = starts[gids][:, None] + np.arange(size)[None, :]
+                feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
+                with _tele.dispatch_span(
+                    "aggregate.size", program=fp,
+                    rows=int(size) * len(gids), size=int(size),
+                ):
+                    outs = vraw(*feeds)
+                maybe_check_numerics(
+                    bases, outs, f"aggregate groups of size {size}"
+                )
+                pending.append((gids, tuple(outs)))
         out_buffers: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}
         for gids, outs in pending:
             for b, o in zip(bases, outs):
@@ -1382,18 +1420,20 @@ def aggregate(
     else:
         # pathological size distributions: pow2 chunk decomposition keeps
         # the compile count O(log max_size) instead of O(#distinct sizes)
-        results.update(
-            _aggregate_chunked(
-                lambda feeds: vraw(*feeds),
-                feed_names,
-                col_data,
-                counts,
-                starts,
-                num_groups,
-                bases,
-                combiners,
+        with _tele.span("aggregate.plan.chunk", kind="stage", program=fp):
+            results.update(
+                _aggregate_chunked(
+                    lambda feeds: vraw(*feeds),
+                    feed_names,
+                    col_data,
+                    counts,
+                    starts,
+                    num_groups,
+                    bases,
+                    combiners,
+                    program=fp,
+                )
             )
-        )
 
     return _keyed_output(key_out, results, bases)
 

@@ -94,6 +94,23 @@ class Config:
     # recompile-storm signal `compile_count` (distinct lowered callables)
     # structurally cannot see. 0 disables the check.
     recompile_warn_shapes: int = 16
+    # Telemetry master switch (`utils.telemetry`): span recording,
+    # histogram observation and jax TraceAnnotation mirroring for every
+    # verb / plan stage / per-block dispatch / compile event. Off =
+    # near-zero overhead (a span site costs one config read and a no-op
+    # context); the legacy flat counters (`stats()`) stay live either
+    # way. Env override TFS_TELEMETRY ("0" disables) seeds the initial
+    # value, mirroring TFS_SHAPE_BUCKETING.
+    telemetry: bool = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "TFS_TELEMETRY", "1"
+        ).lower() not in ("0", "false", "off")
+    )
+    # Span ring-buffer bound (`utils.telemetry`): a long-lived service
+    # keeps the freshest N spans and counts what fell off — memory stays
+    # O(N) no matter how long the process runs. Applied on
+    # `telemetry.reset()` (the ring is rebuilt at the current value).
+    telemetry_ring_entries: int = 8192
     # Spark-style blanket re-execution of failed block runs (pure fns).
     block_retry_attempts: int = 0
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).

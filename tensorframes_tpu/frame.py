@@ -167,10 +167,22 @@ class Column:
             if isinstance(self.values, np.ndarray):
                 return self.values
             if self._host is None:
+                from .utils import telemetry as _tele
                 from .utils.profiling import count
 
                 count("host_sync")
-                self._host = np.asarray(self.values)
+                # host-sync leaf span: blocks on the async pipeline and
+                # pays the D2H copy; attributed to the enclosing
+                # dispatch's program when one is active
+                with _tele.span(
+                    "host_sync", kind="host_sync", column=self.name,
+                    program=_tele.current_program(),
+                ):
+                    self._host = np.asarray(self.values)
+                if _tele.enabled():
+                    _tele.histogram_observe(
+                        "d2h_bytes", float(self._host.nbytes)
+                    )
             return self._host
         if not self.cell_shape.is_scalar:
             raise ValueError(
@@ -466,30 +478,44 @@ class TensorFrame:
         host materialization happens only at `to_pandas`/`collect`."""
         import jax
 
-        new_cols = []
-        for c in self._cols.values():
-            if c.is_dense and c.dtype is not ScalarType.string:
-                # shard_to_mesh splits the lead dim over the 'data' axis only
-                if (
-                    mesh is not None
-                    and "data" in mesh.shape
-                    and len(c) % mesh.shape["data"] == 0
-                ):
-                    from .parallel.mesh import shard_to_mesh
+        from .utils import telemetry as _tele
 
-                    vals = shard_to_mesh(mesh, np.asarray(c.values))
-                elif isinstance(c.values, jax.Array) and mesh is None:
-                    # already device-resident: a device_put here would
-                    # round-trip D2H (np.asarray blocks) then re-upload
-                    new_cols.append(c)
-                    continue
+        h2d_bytes = 0
+        new_cols = []
+        # transfer span: the H2D issue window (device_put is async — the
+        # copy itself overlaps downstream compute; this measures what the
+        # caller's thread paid to start it)
+        with _tele.span("to_device", kind="transfer"):
+            for c in self._cols.values():
+                if c.is_dense and c.dtype is not ScalarType.string:
+                    # shard_to_mesh splits the lead dim over the 'data'
+                    # axis only
+                    if (
+                        mesh is not None
+                        and "data" in mesh.shape
+                        and len(c) % mesh.shape["data"] == 0
+                    ):
+                        from .parallel.mesh import shard_to_mesh
+
+                        host = np.asarray(c.values)
+                        h2d_bytes += host.nbytes
+                        vals = shard_to_mesh(mesh, host)
+                    elif isinstance(c.values, jax.Array) and mesh is None:
+                        # already device-resident: a device_put here would
+                        # round-trip D2H (np.asarray blocks) then re-upload
+                        new_cols.append(c)
+                        continue
+                    else:
+                        host = np.asarray(c.values)
+                        h2d_bytes += host.nbytes
+                        vals = jax.device_put(host)
+                    nc = Column(c.name, vals, c.dtype)
+                    nc.cell_shape = c.cell_shape
+                    new_cols.append(nc)
                 else:
-                    vals = jax.device_put(np.asarray(c.values))
-                nc = Column(c.name, vals, c.dtype)
-                nc.cell_shape = c.cell_shape
-                new_cols.append(nc)
-            else:
-                new_cols.append(c)
+                    new_cols.append(c)
+        if h2d_bytes and _tele.enabled():
+            _tele.histogram_observe("h2d_bytes", float(h2d_bytes))
         return TensorFrame(new_cols, self.offsets)
 
     # ---- lazy plans ----------------------------------------------------

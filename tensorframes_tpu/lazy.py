@@ -159,19 +159,27 @@ class LazyFrame:
 
     def _summary(self):
         """Block-level analysis of the pending graph (memoized globally
-        by fingerprint in `graph.analysis`)."""
+        by fingerprint in `graph.analysis`). Recorded as a ``stage``
+        span: plan analysis runs between verb calls (schema reads, DSL
+        placeholder construction over a pending plan), and without a
+        span that wall time would be unattributed in `diagnostics`."""
         if not self._sources:
             return None
         from .graph.analysis import analyze_graph
+        from .utils import telemetry as _tele
 
         overrides = {
             ph: self._base.info[col].block_shape
             for ph, col in self._feed_map.items()
         }
         fetches = [self._sources[c] for c in sorted(self._sources)]
-        return analyze_graph(
-            self._graph, fetches, placeholder_shapes=overrides
-        )
+        with _tele.span(
+            "lazy.analyze", kind="stage",
+            program=self._graph.fingerprint() if len(self._graph) else None,
+        ):
+            return analyze_graph(
+                self._graph, fetches, placeholder_shapes=overrides
+            )
 
     @property
     def info(self) -> FrameInfo:
@@ -279,10 +287,15 @@ class LazyFrame:
         executor=None,
         mesh=None,
     ) -> "LazyFrame":
-        bindings, new_feeds = self._resolve_placeholders(graph, feed_dict, verb)
-        fused, new_fetches, rename = splice(
-            self._graph, graph, bindings, fetch_list
-        )
+        from .utils import telemetry as _tele
+
+        with _tele.span("lazy.fuse", kind="stage", verb=verb):
+            bindings, new_feeds = self._resolve_placeholders(
+                graph, feed_dict, verb
+            )
+            fused, new_fetches, rename = splice(
+                self._graph, graph, bindings, fetch_list
+            )
         feed_map = dict(self._feed_map)
         for ph, col in new_feeds.items():
             feed_map[rename[ph]] = col
@@ -455,6 +468,9 @@ class LazyFrame:
                     )
                 else:
                     fn = ex.callable_for(fused, fused_fetches, feed_names)
+                from .utils import telemetry as _tele
+
+                fp = fused.fingerprint()
                 partials: List[Tuple] = []
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -467,10 +483,15 @@ class LazyFrame:
                         frame.column(feed_map[n]).values[lo:hi]
                         for n in feed_names
                     ]
-                    if mask_plan is not None:
-                        outs = _sp.dispatch_masked(fn, feeds, hi - lo)
-                    else:
-                        outs = fn(*feeds)
+                    with _tele.dispatch_span(
+                        "reduce_blocks.fused.block", program=fp,
+                        block=bi, rows=hi - lo,
+                        masked=mask_plan is not None or None,
+                    ):
+                        if mask_plan is not None:
+                            outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+                        else:
+                            outs = fn(*feeds)
                     maybe_check_numerics(
                         rfetch, outs, f"reduce_blocks (fused) block {bi}"
                     )
@@ -562,6 +583,9 @@ class LazyFrame:
                         for ph, col in self._feed_map.items()
                     },
                 )
+                from .utils import telemetry as _tele
+
+                fp = self._graph.fingerprint()
                 acc: Dict[str, List] = {n: [] for n in out_names}
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
@@ -574,7 +598,11 @@ class LazyFrame:
                     bucket = hi - lo
                     if bucketed:
                         feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
-                    outs = fn(*feeds)
+                    with _tele.dispatch_span(
+                        "lazy.force.block", program=fp, block=bi,
+                        rows=hi - lo, bucket=bucket if bucketed else None,
+                    ):
+                        outs = fn(*feeds)
                     outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
                     maybe_check_numerics(
                         out_names, outs, f"lazy fused block {bi}"

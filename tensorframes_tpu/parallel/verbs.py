@@ -60,6 +60,26 @@ __all__ = [
 _base = base_name
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _mesh_dispatch(name: str, program, rows: int, shards: int):
+    """THE mesh-dispatch instrumentation wrapper: a `record()` span
+    (``name.calls``/``.seconds``/``.rows`` counters + a ``verb`` span)
+    with a nested ``dispatch`` leaf labeled by program fingerprint and
+    shard count — mesh dispatches previously bypassed profiling
+    entirely (only the api-level verb recorded)."""
+    from ..utils import telemetry as _tele
+    from ..utils.profiling import record as _rec
+
+    with _rec(name, rows):
+        with _tele.dispatch_span(
+            name, program=program, rows=rows, shards=shards
+        ):
+            yield
+
+
 @lru_cache(maxsize=64)
 def _mesh_sig(mesh: Mesh) -> str:
     """Cache-key signature of a mesh's concrete device identity. A
@@ -210,7 +230,10 @@ def map_blocks(
                 )
             ),
         )
-        outs = sharded(*_feeds(main))
+        with _mesh_dispatch(
+            "mesh.map_blocks", graph.fingerprint(), s * ndev, ndev
+        ):
+            outs = sharded(*_feeds(main))
         maybe_check_numerics(fetch_list, outs, "map_blocks (mesh shards)")
         shard_out = None
         for f, o in zip(fetch_list, outs):
@@ -230,7 +253,11 @@ def map_blocks(
         block_sizes += [shard_out if trim else s] * ndev
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
-        outs = tfn(*_feeds(tail))
+        with _mesh_dispatch(
+            "mesh.map_blocks.tail", graph.fingerprint(),
+            tail[cols_used[0]].shape[0], 1,
+        ):
+            outs = tfn(*_feeds(tail))
         maybe_check_numerics(fetch_list, outs, "map_blocks (mesh tail)")
         tail_out = None
         for f, o in zip(fetch_list, outs):
@@ -425,7 +452,10 @@ def map_rows(
                 )
             ),
         )
-        outs = sharded(*_feeds(main))
+        with _mesh_dispatch(
+            "mesh.map_rows", graph.fingerprint(), s * ndev, ndev
+        ):
+            outs = sharded(*_feeds(main))
         maybe_check_numerics(fetch_list, outs, "map_rows (mesh shards)")
         for n, o in zip(out_names, outs):
             acc[n].append(o)
@@ -440,7 +470,11 @@ def map_rows(
             params,
             lambda: jax.jit(jax.vmap(fn, in_axes=in_axes)),
         )
-        outs = vfn(*_feeds(tail))
+        with _mesh_dispatch(
+            "mesh.map_rows.tail", graph.fingerprint(),
+            tail[cols_used[0]].shape[0], 1,
+        ):
+            outs = vfn(*_feeds(tail))
         maybe_check_numerics(fetch_list, outs, "map_rows (mesh tail)")
         for n, o in zip(out_names, outs):
             acc[n].append(o)
@@ -670,7 +704,10 @@ def fused_map_blocks(
                 )
             ),
         )
-        outs = sharded(*[main[c] for c in cols_used])
+        with _mesh_dispatch(
+            "mesh.lazy.force", graph.fingerprint(), s * ndev, ndev
+        ):
+            outs = sharded(*[main[c] for c in cols_used])
         maybe_check_numerics(out_names, outs, "lazy fused map (mesh shards)")
         for n, o in zip(out_names, outs):
             if o.shape[0] != s * ndev:
@@ -682,7 +719,11 @@ def fused_map_blocks(
             acc[n].append(o[: frame.nrows] if pad_rows else o)
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_edges, feed_names)
-        outs = tfn(*[tail[c] for c in cols_used])
+        with _mesh_dispatch(
+            "mesh.lazy.force.tail", graph.fingerprint(),
+            tail[cols_used[0]].shape[0], 1,
+        ):
+            outs = tfn(*[tail[c] for c in cols_used])
         maybe_check_numerics(out_names, outs, "lazy fused map (mesh tail)")
         trows = tail[cols_used[0]].shape[0]
         for n, o in zip(out_names, outs):
@@ -758,11 +799,19 @@ def fused_reduce_blocks(
                 )
             ),
         )
-        outs = sharded(*[main[c] for c in cols_used])
+        with _mesh_dispatch(
+            "mesh.reduce_blocks.fused", fused_graph.fingerprint(),
+            s * ndev, ndev,
+        ):
+            outs = sharded(*[main[c] for c in cols_used])
         partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(fused_graph, fused_fetches, feed_names)
-        outs = tfn(*[tail[c] for c in cols_used])
+        with _mesh_dispatch(
+            "mesh.reduce_blocks.fused.tail", fused_graph.fingerprint(),
+            tail[cols_used[0]].shape[0], 1,
+        ):
+            outs = tfn(*[tail[c] for c in cols_used])
         partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -893,7 +942,10 @@ def reduce_blocks(
                 feed_names,
                 make_masked_sharded,
             )
-            outs = sharded(shard_valids, *[main[c] for c in cols_used])
+            with _mesh_dispatch(
+                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev
+            ):
+                outs = sharded(shard_valids, *[main[c] for c in cols_used])
         else:
             def local_then_gather(*cols):
                 part = fn(*cols)
@@ -919,18 +971,25 @@ def reduce_blocks(
                     )
                 ),
             )
-            outs = sharded(*[main[c] for c in cols_used])
+            with _mesh_dispatch(
+                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev
+            ):
+                outs = sharded(*[main[c] for c in cols_used])
         partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         t = [tail[c] for c in cols_used]
-        if mask_plan is not None:
-            mfn = _sp.masked_callable(
-                ex, graph, fetch_list, feed_names, mask_plan
-            )
-            outs = _sp.dispatch_masked(mfn, t, t[0].shape[0])
-        else:
-            tfn = ex.callable_for(graph, fetch_list, feed_names)
-            outs = tfn(*t)
+        with _mesh_dispatch(
+            "mesh.reduce_blocks.tail", graph.fingerprint(),
+            t[0].shape[0], 1,
+        ):
+            if mask_plan is not None:
+                mfn = _sp.masked_callable(
+                    ex, graph, fetch_list, feed_names, mask_plan
+                )
+                outs = _sp.dispatch_masked(mfn, t, t[0].shape[0])
+            else:
+                tfn = ex.callable_for(graph, fetch_list, feed_names)
+                outs = tfn(*t)
         partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -1027,7 +1086,10 @@ def reduce_rows(
                 )
             ),
         )
-        outs = sharded(*[main[c] for c in cols_used])
+        with _mesh_dispatch(
+            "mesh.reduce_rows", graph.fingerprint(), s * ndev, ndev
+        ):
+            outs = sharded(*[main[c] for c in cols_used])
         partials.append(tuple(np.asarray(o) for o in outs))
 
     # tail folds + partial combine share ONE cached program (jit
@@ -1164,7 +1226,10 @@ def aggregate(
                 )
             ),
         )
-        outs = sharded(gid[: s * ndev], *main_cols)
+        with _mesh_dispatch(
+            "mesh.aggregate.segment", graph.fingerprint(), s * ndev, ndev
+        ):
+            outs = sharded(gid[: s * ndev], *main_cols)
         acc = [np.asarray(o)[:num_keys] for o in outs]
     if tail_cols and tail_cols[0].shape[0] > 0:
         touts = [
@@ -1252,7 +1317,10 @@ def _aggregate_mesh_general(
         # this always shards on any device count, pow2 or not
         lead = feeds[0].shape[0]
         if lead >= ndev and lead % ndev == 0:
-            return sharded(*feeds)
+            with _mesh_dispatch(
+                "mesh.aggregate.chunk", graph.fingerprint(), lead, ndev
+            ):
+                return sharded(*feeds)
         return local(*feeds)
 
     results = _api._aggregate_chunked(
@@ -1265,6 +1333,7 @@ def _aggregate_mesh_general(
         bases,
         combiners,
         pad_quantum=ndev,
+        program=graph.fingerprint(),
     )
     if num_groups == 0:  # empty frame: zero-row outputs from analysis
         results = {

@@ -12,6 +12,7 @@ B executions instead of B session setups.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -95,9 +96,23 @@ class Executor:
         key = (kind, graph.fingerprint(), tuple(fetches), tuple(feed_names))
         from .. import config as _config
 
+        def timed_make():
+            # compile-time attribution (`utils.telemetry`): every cache
+            # miss is timed and labeled by graph fingerprint — this is
+            # the "trace" phase (lowering + jit wrapping); the real XLA
+            # compile per input shape is timed in `_instrument`'s
+            # wrapper ("xla" phase)
+            from ..utils import telemetry as _tele
+
+            t0 = time.perf_counter()
+            fn = self._instrument(key, make())
+            t1 = time.perf_counter()
+            _tele.record_compile(key[1], kind, t1 - t0, "trace", t0, t1)
+            return fn
+
         fn, inserted = lru_get_or_insert(
             self._cache, self._lock, key,
-            lambda: self._instrument(key, make()),
+            timed_make,
             _config.get().executor_cache_entries,
         )
         with self._lock:  # += is not atomic; keep the counts exact
@@ -123,8 +138,54 @@ class Executor:
         if not callable(sizer):
             return fn
 
+        # high-water mark of the jit cache size already ATTRIBUTED to a
+        # compile event: under concurrent dispatch of one program,
+        # several threads can observe the same cache growth (one thread
+        # compiles a new shape while another executes a compiled one),
+        # and without this gate each would record its own call window as
+        # a compile. The first exiting observer of each new size wins —
+        # event COUNTS stay exact per specialization; the recorded
+        # window is that observer's call, so duration is best-effort
+        # under contention.
+        compile_seen = [0]
+        seen_lock = threading.Lock()
+
         def wrapped(*args, **kwargs):
+            from ..utils import telemetry as _tele
+
+            # jit shape re-specialization attribution: when this call
+            # grows the jit cache, the (synchronous) trace+XLA-compile
+            # happened inside it — time the call and label the compile
+            # event with the program fingerprint. Only when telemetry is
+            # on: disabled runs pay nothing beyond the storm check below.
+            n0 = None
+            if _tele.enabled():
+                try:
+                    n0 = sizer()
+                except Exception:
+                    n0 = None
+                t0 = time.perf_counter()
+            if n0 is not None:
+                with seen_lock:
+                    if compile_seen[0] < n0:
+                        compile_seen[0] = n0  # pre-instrumentation shapes
             out = fn(*args, **kwargs)
+            if n0 is not None:
+                try:
+                    n1 = sizer()
+                except Exception:
+                    n1 = None
+                record = False
+                if n1 is not None and n1 > n0:
+                    with seen_lock:
+                        if n1 > compile_seen[0]:
+                            compile_seen[0] = n1
+                            record = True
+                if record:
+                    t1 = time.perf_counter()
+                    _tele.record_compile(
+                        key[1], key[0], t1 - t0, "xla", t0, t1
+                    )
             from .. import config as _config
 
             threshold = _config.get().recompile_warn_shapes
@@ -179,6 +240,27 @@ class Executor:
         wrapped.__wrapped__ = fn
         return wrapped
 
+    def program_shape_compiles(self) -> Dict[Tuple, int]:
+        """Per-program XLA shape specializations: cache key ``(kind,
+        fingerprint, fetches, feeds)`` -> the program's live jit cache
+        size. The per-program view behind `jit_shape_compiles` — and
+        what `tfs.diagnostics()` renders as the recompile-storm table
+        ("which program is eating my startup"). Entries without a jit
+        cache handle count as 1."""
+        with self._lock:
+            items = list(self._cache.items())
+        out: Dict[Tuple, int] = {}
+        for key, fn in items:
+            sizer = getattr(fn, "_cache_size", None)
+            if callable(sizer):
+                try:
+                    out[key] = int(sizer())
+                    continue
+                except Exception:
+                    pass
+            out[key] = 1
+        return out
+
     def jit_shape_compiles(self) -> int:
         """Total XLA shape specializations across LIVE cached programs:
         the sum of every program's jit cache size (each distinct input
@@ -187,19 +269,7 @@ class Executor:
         stays O(log max-block-rows) per program no matter how block
         sizes drift. Entries without a jit cache handle count as 1;
         evicted entries' compiles are forgotten with them."""
-        with self._lock:
-            fns = list(self._cache.values())
-        total = 0
-        for fn in fns:
-            sizer = getattr(fn, "_cache_size", None)
-            if callable(sizer):
-                try:
-                    total += int(sizer())
-                    continue
-                except Exception:
-                    pass
-            total += 1
-        return total
+        return sum(self.program_shape_compiles().values())
 
     def callable_for(
         self,

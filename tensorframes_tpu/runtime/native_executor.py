@@ -102,13 +102,17 @@ class NativeExecutor:
         ex._bind_host(host, jax_fallback)
         return ex
 
-    def _native_run(self, traceable: Callable) -> Callable:
+    def _native_run(
+        self, traceable: Callable, label: Optional[Tuple] = None
+    ) -> Callable:
         """Wrap a jittable function (possibly taking/returning pytrees)
         as a native-host call: lower per concrete input-shape signature,
         compile through the host, execute with flat numpy buffers, and
         rebuild the output pytree. The lowered module's parameter and
         result orders are the flattened pytree orders, which is what
-        makes this correct for dict-carrying folds too."""
+        makes this correct for dict-carrying folds too. ``label`` (the
+        executor cache key, when called from `cached`) attributes each
+        per-shape host compile to its graph fingerprint in telemetry."""
         exe_cache: Dict[Tuple, Tuple] = {}
 
         def run(*args):
@@ -122,6 +126,9 @@ class NativeExecutor:
             )
             entry = exe_cache.get(shape_key)
             if entry is None:
+                import time as _time
+
+                _t0 = _time.perf_counter()
                 structs = jax.tree_util.tree_unflatten(
                     in_tree,
                     [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat_in],
@@ -176,6 +183,21 @@ class NativeExecutor:
                         self.compile_count += 1
                     entry = (exe, out_specs, out_tree)
                     exe_cache[shape_key] = entry
+                    # each (program, shape signature) is one real host
+                    # compile — attribute it like the jit "xla" phase
+                    from ..utils import telemetry as _tele
+
+                    _t1 = _time.perf_counter()
+                    _tele.record_compile(
+                        label[1] if label else getattr(
+                            traceable, "__name__", "<fn>"
+                        ),
+                        label[0] if label else "fn",
+                        _t1 - _t0,
+                        "native",
+                        _t0,
+                        _t1,
+                    )
             if entry[0] == "jax":
                 return entry[1](*args)
             exe, out_specs, out_tree = entry
@@ -228,7 +250,7 @@ class NativeExecutor:
         # in-process JAX backend.
         fn, inserted = lru_get_or_insert(
             self._cache, self._lock, key,
-            lambda: self._native_run(make()),
+            lambda: self._native_run(make(), label=key),
             _config.get().executor_cache_entries,
         )
         with self._lock:  # mirror Executor.cached's hit/miss accounting

@@ -162,6 +162,10 @@ def pad_feeds(feeds: Sequence, n: int) -> Tuple[List, int]:
     from .utils.profiling import count as _count
 
     _count("shape_bucketing.padded_dispatch")
+    # pad waste observability: total synthetic rows dispatched (the
+    # price paid for the bounded compile count — `diagnostics` readers
+    # compare this against real row counters)
+    _count("shape_bucketing.pad_rows", b - n)
     return [pad_lead(f, n, b) for f in feeds], b
 
 

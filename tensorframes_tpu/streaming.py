@@ -18,6 +18,8 @@ from .frame import TensorFrame
 from .graph.analysis import analyze_graph
 from .graph.ir import base_name as _base
 from .runtime.executor import Executor
+from .utils import telemetry as _telemetry
+from .utils.profiling import record
 
 # late-bound: api imports this module, so helper lookups resolve at
 # call time through the module object (same pattern as parallel/verbs)
@@ -108,8 +110,15 @@ def _prefetch_iter(it, depth: int = 1, stage=None):
 
         threading.Thread(target=stager, daemon=True).start()
 
+    from .utils import telemetry as _tele
+
     try:
         while True:
+            if _tele.enabled():
+                # queue depth at each consume: how far ahead the
+                # producer/transfer stages are running (0 = the consumer
+                # is starved, depth = the pipeline is saturated)
+                _tele.gauge_set("stream_queue_depth", q_out.qsize())
             kind, payload = q_out.get()
             if kind == "error":
                 raise payload
@@ -263,13 +272,19 @@ def reduce_blocks_stream(
                     fold_every = 64
             except Exception:
                 pass  # conservative: no folding when classification fails
-        r = _api.reduce_blocks(
-            graph, f, feed_dict, fetch_names=fetch_list,
-            executor=executor, mesh=mesh,
-        )
+        # per-chunk span/counters: stream chunks previously bypassed
+        # profiling entirely (only the inner verb recorded); the chunk
+        # record attributes each dispatch to the stream and carries the
+        # chunk row count
+        with record("reduce_blocks_stream.chunk", int(nrows or 0)):
+            r = _api.reduce_blocks(
+                graph, f, feed_dict, fetch_names=fetch_list,
+                executor=executor, mesh=mesh,
+            )
         partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
         if fold_every is not None and len(partials) >= fold_every:
-            partials = [_combine(partials)]
+            with _telemetry.span("reduce_blocks_stream.fold", kind="stage"):
+                partials = [_combine(partials)]
         elif fold_every is None and len(partials) > 1:
             # no tree-fold will ever drain this list: spill the PREVIOUS
             # chunk's (already computed) partial to host so unfoldable
@@ -285,7 +300,11 @@ def reduce_blocks_stream(
             "reduce_blocks_stream over an empty iterator (or every chunk "
             "had zero rows)"
         )
-    out = partials[0] if len(partials) == 1 else _combine(partials)
+    if len(partials) == 1:
+        out = partials[0]
+    else:
+        with _telemetry.span("reduce_blocks_stream.fold", kind="stage"):
+            out = _combine(partials)
     if len(fetch_list) == 1:
         return out[_base(fetch_list[0])]
     return out

@@ -1,7 +1,13 @@
-"""Auxiliary subsystems: profiling, checkpointing."""
+"""Auxiliary subsystems: profiling, telemetry, checkpointing."""
 
+from . import telemetry
 from .checkpoint import load_frame, load_params, save_frame, save_params
 from .profiling import annotate, record, reset_stats, stats, trace
+from .telemetry import (
+    diagnostics,
+    export_chrome_trace,
+    export_prometheus,
+)
 from .virtual_mesh import force_virtual_cpu_devices
 
 __all__ = [
@@ -15,4 +21,8 @@ __all__ = [
     "reset_stats",
     "stats",
     "trace",
+    "telemetry",
+    "diagnostics",
+    "export_chrome_trace",
+    "export_prometheus",
 ]

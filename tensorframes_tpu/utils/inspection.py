@@ -81,22 +81,33 @@ def executor_stats(executor=None) -> Dict[str, int]:
     shape-churn recompile storm shows up ONLY here (growing with call
     count while cache_misses stall). Under ``config.shape_bucketing``
     it stays O(log max-block-rows) per program; pair with
-    `cost_analysis` to see what each recompile costs."""
+    `cost_analysis` to see what each recompile costs.
+
+    An executor that cannot count shape specializations (no callable
+    ``jit_shape_compiles`` — e.g. a bare counting stub) reports
+    ``jit_shape_compiles: 0`` with ``jit_shape_compiles_estimated:
+    True`` instead of silently substituting ``compile_count``: the two
+    are DIFFERENT signals (distinct lowered programs vs XLA compiles
+    per shape), and conflating them hides exactly the recompile storm
+    this key exists to expose. Both real executors (`Executor`,
+    `NativeExecutor`) implement the method, so the flag never appears
+    for them."""
     from ..runtime.executor import default_executor
 
     ex = executor if executor is not None else default_executor()
     shape_compiles = getattr(ex, "jit_shape_compiles", None)
-    return {
+    out = {
         "compile_count": int(getattr(ex, "compile_count", 0)),
         "cache_hits": int(getattr(ex, "cache_hits", 0)),
         "cache_misses": int(getattr(ex, "cache_misses", 0)),
         "cache_entries": len(getattr(ex, "_cache", ())),
-        "jit_shape_compiles": (
-            int(shape_compiles())
-            if callable(shape_compiles)
-            else int(getattr(ex, "compile_count", 0))
-        ),
     }
+    if callable(shape_compiles):
+        out["jit_shape_compiles"] = int(shape_compiles())
+    else:
+        out["jit_shape_compiles"] = 0
+        out["jit_shape_compiles_estimated"] = True
+    return out
 
 
 def cost_analysis(

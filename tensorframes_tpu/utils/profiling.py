@@ -9,74 +9,68 @@ rides the XLA/PJRT profiler:
   including per-op device timings from the PJRT plugin.
 - ``annotate(name)``: named region that shows up on the trace timeline
   (wraps `jax.profiler.TraceAnnotation`).
-- ``ExecStats``: lightweight process-global counters (compiles, verb
-  calls, rows processed, wall time per verb) — the `explain`-style
-  observability layer; read with `stats()`, reset with `reset_stats()`.
+- ``record`` / ``count`` / ``stats`` / ``reset_stats``: the legacy flat
+  counter surface, now thin shims over `utils.telemetry`'s metrics
+  registry — same keys (``<verb>.calls``/``.seconds``/``.rows``,
+  ``host_sync``, plan counters), so no call site or test breaks. When
+  ``config.telemetry`` is on, `record` ALSO opens a structured ``verb``
+  span (ring-buffered, exportable as a Chrome trace) and feeds the
+  per-verb latency histogram; see `utils.telemetry` for the span model,
+  exporters and `diagnostics()`.
 """
 
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
-from collections import defaultdict
 from typing import Dict
+
+from . import telemetry as _tele
 
 __all__ = ["trace", "annotate", "record", "count", "stats", "reset_stats"]
 
 
-class ExecStats:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.counters: Dict[str, float] = defaultdict(float)
-
-    def add(self, key: str, value: float = 1.0) -> None:
-        with self._lock:
-            self.counters[key] += value
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            return dict(self.counters)
-
-    def reset(self) -> None:
-        with self._lock:
-            self.counters.clear()
-
-
-_stats = ExecStats()
-
-
 def stats() -> Dict[str, float]:
-    """Process-global execution counters."""
-    return _stats.snapshot()
+    """Process-global execution counters (the flat legacy view over the
+    telemetry registry; labeled counters render as ``name{k=v}``)."""
+    return _tele.flat_counters()
 
 
 def reset_stats() -> None:
-    _stats.reset()
+    """Clear the counters (legacy semantics — spans/histograms/gauges
+    are cleared by the wider `telemetry.reset()`)."""
+    _tele.reset_counters()
 
 
 def count(key: str, value: float = 1.0) -> None:
     """Bump a named counter (e.g. which aggregate plan engaged)."""
-    _stats.add(key, value)
+    _tele.counter_inc(key, value)
 
 
 @contextlib.contextmanager
 def record(verb: str, rows: int = 0):
-    """Time one verb invocation into the stats registry."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        _stats.add(f"{verb}.calls")
-        _stats.add(f"{verb}.seconds", dt)
-        if rows:
-            _stats.add(f"{verb}.rows", rows)
+    """Time one verb invocation: bump the legacy counters, and — when
+    telemetry is on — record a ``verb`` span and observe the per-verb
+    latency histogram."""
+    with _tele.span(verb, kind="verb", rows=rows or None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            _tele.counter_inc(f"{verb}.calls")
+            _tele.counter_inc(f"{verb}.seconds", dt)
+            if rows:
+                _tele.counter_inc(f"{verb}.rows", rows)
+            if _tele.enabled():
+                _tele.histogram_observe("verb_seconds", dt, verb=verb)
 
 
 @contextlib.contextmanager
 def trace(logdir: str):
-    """Capture an XLA/PJRT device trace into ``logdir``."""
+    """Capture an XLA/PJRT device trace into ``logdir``. Telemetry spans
+    are mirrored into `jax.profiler.TraceAnnotation`, so they appear on
+    this timeline aligned with the XLA device activity."""
     import jax
 
     jax.profiler.start_trace(logdir)

@@ -1,0 +1,865 @@
+"""Structured span tracing + metrics: see every block dispatch.
+
+The reference vendored `StepStats`/`NodeExecStats` protos that nothing
+ever consumed (SURVEY §5: "tracing: absent"). After the perf PRs made
+the hot path device-resident, fused and shape-bucketed, a verb call
+fans out into cached programs, bucketed dispatches and async device
+folds — a flat counter dict cannot attribute wall time anymore. This
+module is the observability layer those protos never had:
+
+- **Spans** — hierarchical timed regions (verb → plan stage → per-block
+  dispatch → compile / transfer / execute / host-sync leaves) recorded
+  into a bounded thread-safe ring buffer with parent ids and monotonic
+  timestamps. Nesting rides contextvars, so a lazy ``.force()``, a
+  stream chunk, or a mesh shard_map dispatch attributes to the
+  user-facing verb that triggered it. Every span is mirrored into
+  `jax.profiler.TraceAnnotation`, so spans line up with the XLA device
+  timeline under ``tfs.utils.trace(logdir)``.
+- **Metrics registry** — labeled counters (the old flat `stats()` dict
+  is a view over the unlabeled ones), gauges (executor cache entries,
+  live device buffers, stream queue depth), and fixed-bucket histograms
+  (per-verb latency, block rows, compile seconds per program,
+  H2D/D2H bytes).
+- **Exporters** — `export_chrome_trace(path)` (trace-event JSON,
+  loadable in Perfetto / chrome://tracing), `export_prometheus()`
+  (Prometheus text format), and `diagnostics()` — a human report that
+  merges span aggregates with `executor_stats()` and the
+  recompile-storm signal.
+
+Overhead contract: ``config.telemetry`` (env ``TFS_TELEMETRY``, default
+ON) gates ALL span recording, histogram observation and annotation —
+when off, a span site costs one config read and a no-op context
+manager. Counters are always live (they predate this module:
+``host_sync``, ``<verb>.calls`` and friends are asserted by tests and
+benchmarks), and `record()`/`count()` keep their exact signatures as
+thin shims over the registry, so no call site breaks.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Span",
+    "enabled",
+    "span",
+    "dispatch_span",
+    "add_event",
+    "record_compile",
+    "counter_inc",
+    "gauge_set",
+    "gauge_register",
+    "histogram_observe",
+    "spans",
+    "span_aggregates",
+    "metrics_snapshot",
+    "flat_counters",
+    "export_chrome_trace",
+    "export_prometheus",
+    "diagnostics",
+    "reset",
+    "reset_counters",
+]
+
+
+def enabled() -> bool:
+    """Telemetry master switch (``config.telemetry`` / ``TFS_TELEMETRY``)."""
+    from .. import config as _config
+
+    return _config.get().telemetry
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Span:
+    """One finished timed region. ``t0``/``t1`` are `time.perf_counter`
+    seconds (monotonic, process-local); ``parent_id`` links to the
+    enclosing span (None for a root); ``kind`` is the coarse phase the
+    aggregators group by: ``verb`` | ``stage`` | ``dispatch`` |
+    ``compile`` | ``transfer`` | ``host_sync`` | ``span``. Not frozen:
+    a frozen dataclass pays `object.__setattr__` per field, and spans
+    are constructed on every dispatch exit."""
+
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    kind: str
+    t0: float
+    t1: float
+    thread: int
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+
+class _SpanRing:
+    """Bounded thread-safe span store. Evicting the oldest spans (not
+    refusing new ones) keeps a long-lived service's freshest window
+    exportable; ``dropped`` counts what fell off so exports can say so."""
+
+    def __init__(self, maxlen: int):
+        self._lock = threading.Lock()
+        self._ring: "deque[Span]" = deque(maxlen=max(1, int(maxlen)))
+        self.dropped = 0
+
+    def append(self, s: Span) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(s)
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def maxlen(self) -> int:
+        return self._ring.maxlen or 0
+
+
+def _ring_size() -> int:
+    from .. import config as _config
+
+    return int(getattr(_config.get(), "telemetry_ring_entries", 8192))
+
+
+_ids = itertools.count(1)  # next() is GIL-atomic in CPython
+_ring = _SpanRing(8192)
+
+_CURRENT: "contextvars.ContextVar[Optional[int]]" = contextvars.ContextVar(
+    "tfs_current_span", default=None
+)
+_PROGRAM: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "tfs_current_program", default=None
+)
+
+_annotation_cls = None  # resolved once; False = unavailable
+
+
+def _annotation(name: str):
+    """`jax.profiler.TraceAnnotation` mirror (cheap when no profiler
+    trace is active) — or None when jax is unimportable."""
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            import jax
+
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:
+            _annotation_cls = False
+    if _annotation_cls is False:
+        return None
+    try:
+        return _annotation_cls(name)
+    except Exception:
+        return None
+
+
+class _NullCtx:
+    """The disabled-telemetry context: one shared instance, no state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _SpanCtx:
+    """Class-based span context (contextlib generators cost ~10µs per
+    enter/exit pair — too much for a per-block dispatch site; this is
+    ~3x cheaper). On exit the finished `Span` goes into the ring; an
+    exception passing through records ``attrs['error']`` with the
+    exception type so a trace of a failed run shows where it died."""
+
+    __slots__ = (
+        "name", "kind", "attrs", "sid", "parent", "tok", "ann", "t0",
+        "ptok", "program",
+    )
+
+    def __init__(self, name, kind, attrs, program=None):
+        self.name = name
+        self.kind = kind
+        self.attrs = attrs
+        self.program = program  # non-None => set the program contextvar
+        self.ptok = None
+
+    def __enter__(self):
+        self.sid = next(_ids)
+        self.parent = _CURRENT.get()
+        self.tok = _CURRENT.set(self.sid)
+        if self.program is not None:
+            self.ptok = _PROGRAM.set(self.program)
+        ann = _annotation(self.name)
+        self.ann = ann
+        if ann is not None:
+            ann.__enter__()
+        self.t0 = time.perf_counter()
+        return self.sid
+
+    def __exit__(self, et, ev, tb):
+        t1 = time.perf_counter()
+        if self.ann is not None:
+            self.ann.__exit__(None, None, None)
+        if self.ptok is not None:
+            _PROGRAM.reset(self.ptok)
+        _CURRENT.reset(self.tok)
+        attrs = self.attrs
+        if et is not None:
+            attrs = dict(attrs)
+            attrs["error"] = et.__name__
+        _ring.append(
+            Span(
+                self.sid, self.parent, self.name, self.kind, self.t0, t1,
+                threading.get_ident(), attrs,
+            )
+        )
+        return False
+
+
+def span(name: str, kind: str = "span", **attrs):
+    """Record a timed region into the ring (no-op context when telemetry
+    is disabled). Entering yields the span id."""
+    if not enabled():
+        return _NULL
+    return _SpanCtx(name, kind, attrs)
+
+
+def dispatch_span(
+    name: str,
+    program: Optional[str] = None,
+    block: Optional[int] = None,
+    rows: Optional[int] = None,
+    **attrs,
+):
+    """A per-block dispatch leaf: a ``dispatch`` span labeled with the
+    program fingerprint (what `diagnostics` groups execute time by),
+    plus a `block_rows` histogram observation. Sets the current-program
+    contextvar so a host-sync triggered inside attributes to the same
+    program."""
+    if not enabled():
+        return _NULL
+    if rows is not None:
+        histogram_observe("block_rows", float(rows))
+    attrs["program"] = program
+    attrs["block"] = block
+    attrs["rows"] = rows
+    return _SpanCtx(name, "dispatch", attrs, program=program)
+
+
+def current_program() -> Optional[str]:
+    """Program fingerprint of the enclosing dispatch span, if any."""
+    return _PROGRAM.get()
+
+
+def add_event(
+    name: str, kind: str, t0: float, t1: float, **attrs
+) -> None:
+    """Record an ALREADY-TIMED region retroactively (parented to the
+    current span). Used where the region is only recognized after the
+    fact — e.g. a jit call that turned out to include an XLA shape
+    specialization."""
+    if not enabled():
+        return
+    _ring.append(
+        Span(
+            next(_ids), _CURRENT.get(), name, kind, t0, t1,
+            threading.get_ident(), attrs,
+        )
+    )
+
+
+def record_compile(
+    program: str,
+    cache_kind: str,
+    seconds: float,
+    phase: str,
+    t0: Optional[float] = None,
+    t1: Optional[float] = None,
+) -> None:
+    """Compile-time attribution: one call per timed compile event.
+    ``phase`` distinguishes ``trace`` (an `lru_get_or_insert` miss:
+    graph lowering + jit wrapping), ``xla`` (a jit shape
+    re-specialization — the REAL XLA compile) and ``native`` (a PJRT
+    host compile). Fully gated on the master switch — the
+    (program, phase)-labeled histogram entries would otherwise
+    accumulate per distinct fingerprint in a service that explicitly
+    disabled telemetry, and the ``telemetry.compiles.*`` counters would
+    leak into the legacy `stats()` dict."""
+    if not enabled():
+        return
+    prog = str(program)
+    histogram_observe("compile_seconds", seconds, program=prog, phase=phase)
+    counter_inc(f"telemetry.compiles.{phase}")
+    if t0 is not None and t1 is not None:
+        add_event(
+            f"compile[{phase}]:{cache_kind}",
+            "compile",
+            t0,
+            t1,
+            program=prog,
+            cache_kind=cache_kind,
+            phase=phase,
+        )
+
+
+def spans() -> List[Span]:
+    """Snapshot of the span ring (oldest first)."""
+    return _ring.snapshot()
+
+
+def spans_dropped() -> int:
+    return _ring.dropped
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+LabelItems = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> LabelItems:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+# fixed bucket ladders per histogram family — fixed (not adaptive) so
+# concurrent observers never re-bucket and exports are stable
+_DEFAULT_BUCKETS: Dict[str, Tuple[float, ...]] = {
+    "seconds": (
+        1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
+        1e-1, 2.5e-1, 5e-1, 1.0, 2.5, 5.0, 10.0, 30.0,
+    ),
+    "rows": (
+        1.0, 8.0, 64.0, 512.0, 4096.0, 32768.0, 262144.0, 2097152.0,
+        16777216.0, 134217728.0, 1073741824.0,
+    ),
+    "bytes": (
+        256.0, 4096.0, 65536.0, 1048576.0, 16777216.0, 268435456.0,
+        4294967296.0,
+    ),
+}
+
+# histogram name -> bucket family
+_HISTOGRAM_FAMILIES: Dict[str, str] = {
+    "verb_seconds": "seconds",
+    "compile_seconds": "seconds",
+    "block_rows": "rows",
+    "h2d_bytes": "bytes",
+    "d2h_bytes": "bytes",
+}
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        i = 0
+        for b in self.buckets:
+            if v <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.sum += v
+        self.count += 1
+
+
+class MetricsRegistry:
+    """Thread-safe labeled counters, gauges and fixed-bucket histograms.
+
+    One lock; every mutation is a few dict ops under it (the same cost
+    profile as the `ExecStats` dict this replaces). Gauges come in two
+    flavors: *registered* callables (evaluated at export — e.g. executor
+    cache entries) and *set* values (pushed by the producer — e.g.
+    stream queue depth)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, LabelItems], float] = {}
+        self._gauges: Dict[Tuple[str, LabelItems], float] = {}
+        self._gauge_fns: Dict[str, Callable[[], float]] = {}
+        self._histograms: Dict[Tuple[str, LabelItems], _Histogram] = {}
+
+    # -- counters -------------------------------------------------------
+    def counter_inc(
+        self, name: str, value: float = 1.0, **labels
+    ) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def flat_counters(self) -> Dict[str, float]:
+        """The legacy `stats()` view: unlabeled counters by bare name,
+        labeled ones rendered ``name{k=v,...}``."""
+        with self._lock:
+            items = list(self._counters.items())
+        out: Dict[str, float] = {}
+        for (name, labels), v in items:
+            if not labels:
+                out[name] = v
+            else:
+                lab = ",".join(f"{k}={val}" for k, val in labels)
+                out[f"{name}{{{lab}}}"] = v
+        return out
+
+    # -- gauges ---------------------------------------------------------
+    def gauge_set(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = float(value)
+
+    def gauge_register(self, name: str, fn: Callable[[], float]) -> None:
+        """Registered gauges survive `reset()` (they read live process
+        state, they don't accumulate)."""
+        with self._lock:
+            self._gauge_fns[name] = fn
+
+    def gauge_values(self) -> Dict[Tuple[str, LabelItems], float]:
+        with self._lock:
+            out = dict(self._gauges)
+            fns = list(self._gauge_fns.items())
+        for name, fn in fns:
+            try:
+                out[(name, ())] = float(fn())
+            except Exception:
+                pass  # a dead gauge must never break an export
+        return out
+
+    # -- histograms -----------------------------------------------------
+    def histogram_observe(self, name: str, value: float, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._histograms.get(key)
+            if h is None:
+                fam = _HISTOGRAM_FAMILIES.get(name, "seconds")
+                h = _Histogram(_DEFAULT_BUCKETS[fam])
+                self._histograms[key] = h
+            h.observe(float(value))
+
+    def histogram_snapshot(self):
+        with self._lock:
+            return {
+                key: (h.buckets, tuple(h.counts), h.sum, h.count)
+                for key, h in self._histograms.items()
+            }
+
+    # -- lifecycle ------------------------------------------------------
+    def reset_counters(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            # _gauge_fns survive: they read live state, not history
+
+
+_registry = MetricsRegistry()
+
+
+def counter_inc(name: str, value: float = 1.0, **labels) -> None:
+    _registry.counter_inc(name, value, **labels)
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    _registry.gauge_set(name, value, **labels)
+
+
+def gauge_register(name: str, fn: Callable[[], float]) -> None:
+    _registry.gauge_register(name, fn)
+
+
+def histogram_observe(name: str, value: float, **labels) -> None:
+    _registry.histogram_observe(name, value, **labels)
+
+
+def flat_counters() -> Dict[str, float]:
+    return _registry.flat_counters()
+
+
+def metrics_snapshot():
+    """(counters, gauges, histograms) snapshot for exporters/tests."""
+    return (
+        _registry.flat_counters(),
+        _registry.gauge_values(),
+        _registry.histogram_snapshot(),
+    )
+
+
+def reset_counters() -> None:
+    """The legacy `reset_stats()` semantics: counters only."""
+    _registry.reset_counters()
+
+
+def reset() -> None:
+    """Full telemetry reset: spans, counters, gauges, histograms — the
+    test-isolation hook (conftest autouse fixture). Registered gauge
+    callables survive; the ring is rebuilt at the CURRENT
+    ``config.telemetry_ring_entries`` so a scoped override takes effect
+    here."""
+    global _ring
+    _ring = _SpanRing(_ring_size())
+    _registry.reset()
+
+
+# built-in process gauges -----------------------------------------------
+
+
+def _gauge_executor_cache_entries() -> float:
+    """Live compiled-program entries across BOTH process-default
+    executors: the in-process JAX executor and the native-host default
+    (`config.native_executor="auto"/"require"` routes verbs there, and
+    reporting only `_default` would show 0 while the native cache is
+    full). Reads module globals only — never constructs an executor."""
+    from ..runtime import executor as _exmod
+
+    total = 0.0
+    for ex in (_exmod._default, _exmod._native_default):
+        if ex is not None:
+            total += len(getattr(ex, "_cache", ()))
+    return total
+
+
+def _gauge_live_device_buffers() -> float:
+    import jax
+
+    return float(len(jax.live_arrays()))
+
+
+gauge_register("executor_cache_entries", _gauge_executor_cache_entries)
+gauge_register("live_device_buffers", _gauge_live_device_buffers)
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+def _union_seconds(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [t0, t1] intervals (overlap-safe —
+    concurrent verbs on several threads must not count twice)."""
+    if not intervals:
+        return 0.0
+    intervals = sorted(intervals)
+    total = 0.0
+    cur0, cur1 = intervals[0]
+    for a, b in intervals[1:]:
+        if a > cur1:
+            total += cur1 - cur0
+            cur0, cur1 = a, b
+        else:
+            cur1 = max(cur1, b)
+    return total + (cur1 - cur0)
+
+
+def span_aggregates(span_list: Optional[List[Span]] = None) -> Dict:
+    """Structured aggregates over the span ring: wall-clock coverage by
+    root spans, totals by verb / by kind, and the per-program
+    compile-vs-execute-vs-host-sync attribution table."""
+    ss = spans() if span_list is None else span_list
+    if not ss:
+        return {
+            "window": 0.0, "covered": 0.0, "coverage": 0.0, "roots": 0,
+            "spans": 0, "dropped": spans_dropped(),
+            "by_verb": {}, "by_kind": {}, "by_program": {},
+        }
+    window0 = min(s.t0 for s in ss)
+    window1 = max(s.t1 for s in ss)
+    roots = [s for s in ss if s.parent_id is None]
+    covered = _union_seconds([(s.t0, s.t1) for s in roots])
+    window = max(window1 - window0, 1e-12)
+    by_verb: Dict[str, Dict[str, float]] = {}
+    by_kind: Dict[str, Dict[str, float]] = {}
+    by_program: Dict[str, Dict[str, float]] = {}
+    for s in ss:
+        k = by_kind.setdefault(s.kind, {"seconds": 0.0, "count": 0})
+        k["seconds"] += s.seconds
+        k["count"] += 1
+        if s.kind == "verb":
+            v = by_verb.setdefault(
+                s.name, {"seconds": 0.0, "calls": 0, "rows": 0.0}
+            )
+            v["seconds"] += s.seconds
+            v["calls"] += 1
+            v["rows"] += float(s.attrs.get("rows") or 0)
+        prog = s.attrs.get("program")
+        if prog:
+            p = by_program.setdefault(
+                str(prog),
+                {
+                    "compile_s": 0.0, "compiles": 0,
+                    "execute_s": 0.0, "dispatches": 0,
+                    "host_sync_s": 0.0, "host_syncs": 0,
+                },
+            )
+            if s.kind == "compile":
+                p["compile_s"] += s.seconds
+                p["compiles"] += 1
+            elif s.kind == "dispatch":
+                p["execute_s"] += s.seconds
+                p["dispatches"] += 1
+            elif s.kind == "host_sync":
+                p["host_sync_s"] += s.seconds
+                p["host_syncs"] += 1
+    return {
+        "window": window,
+        "covered": covered,
+        "coverage": min(1.0, covered / window),
+        "roots": len(roots),
+        "spans": len(ss),
+        "dropped": spans_dropped(),
+        "by_verb": by_verb,
+        "by_kind": by_kind,
+        "by_program": by_program,
+    }
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def _json_safe(v):
+    """Span attrs carry numpy scalars (row counts come from offset
+    arrays); coerce to native JSON types so the export never raises."""
+    if isinstance(v, (str, bool, int, float)) or v is None:
+        return v
+    item = getattr(v, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    return str(v)
+
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict:
+    """Span ring as Chrome trace-event JSON (complete "X" events;
+    open `chrome://tracing` or https://ui.perfetto.dev and load the
+    file). Nesting renders from same-tid timestamp containment, and each
+    event's ``args`` carries the span/parent ids, so verb → dispatch →
+    compile structure survives the export. Returns the trace object;
+    writes it to ``path`` when given."""
+    events = []
+    for s in spans():
+        args = {
+            k: _json_safe(v) for k, v in s.attrs.items() if v is not None
+        }
+        args["span_id"] = s.span_id
+        if s.parent_id is not None:
+            args["parent_id"] = s.parent_id
+        events.append(
+            {
+                "name": s.name,
+                "cat": s.kind,
+                "ph": "X",
+                "ts": s.t0 * 1e6,  # microseconds, monotonic clock
+                "dur": (s.t1 - s.t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": s.thread,
+                "args": args,
+            }
+        )
+    obj = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "tensorframes_tpu.telemetry",
+            "spans_dropped": spans_dropped(),
+        },
+    }
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(obj, f)
+    return obj
+
+
+def _prom_name(name: str) -> str:
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return f"tfs_{safe}"
+
+
+def _prom_labels(labels: LabelItems, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def export_prometheus() -> str:
+    """Counters, gauges and histograms in Prometheus text exposition
+    format (histograms with cumulative ``le`` buckets + ``_sum`` /
+    ``_count``), ready for a textfile collector or a /metrics handler."""
+    lines: List[str] = []
+    with _registry._lock:
+        counters = list(_registry._counters.items())
+        hists = [
+            (key, (h.buckets, tuple(h.counts), h.sum, h.count))
+            for key, h in _registry._histograms.items()
+        ]
+    gauges = _registry.gauge_values()
+
+    seen_types: set = set()
+
+    def _type(name: str, t: str) -> None:
+        if name not in seen_types:
+            seen_types.add(name)
+            lines.append(f"# TYPE {name} {t}")
+
+    for (name, labels), v in sorted(counters):
+        pn = _prom_name(name)
+        _type(pn, "counter")
+        lines.append(f"{pn}{_prom_labels(labels)} {v:g}")
+    for (name, labels), v in sorted(gauges.items()):
+        pn = _prom_name(name)
+        _type(pn, "gauge")
+        lines.append(f"{pn}{_prom_labels(labels)} {v:g}")
+    for (name, labels), (buckets, counts, hsum, hcount) in sorted(hists):
+        pn = _prom_name(name)
+        _type(pn, "histogram")
+        cum = 0
+        for b, c in zip(buckets, counts[:-1]):
+            cum += c
+            le = 'le="%g"' % b
+            lines.append(f"{pn}_bucket{_prom_labels(labels, le)} {cum}")
+        cum += counts[-1]
+        inf = 'le="+Inf"'
+        lines.append(f"{pn}_bucket{_prom_labels(labels, inf)} {cum}")
+        lines.append(f"{pn}_sum{_prom_labels(labels)} {hsum:g}")
+        lines.append(f"{pn}_count{_prom_labels(labels)} {hcount}")
+    return "\n".join(lines) + "\n"
+
+
+def diagnostics(executor=None) -> str:
+    """The one-call "where did my wall time go" report: span coverage,
+    per-verb totals, time by phase, the per-program
+    compile/execute/host-sync attribution table (keyed by graph
+    fingerprint — "which program is eating my startup" is the compile
+    column), merged with `executor_stats()` and the recompile-storm
+    signal. Exposed as ``tfs.diagnostics()``."""
+    from .inspection import executor_stats
+
+    agg = span_aggregates()
+    lines = ["tensorframes-tpu diagnostics", "=" * 28]
+    if not enabled():
+        lines.append(
+            "telemetry is DISABLED (config.telemetry=False / "
+            "TFS_TELEMETRY=0): spans below reflect only what was "
+            "recorded while it was on"
+        )
+    lines.append(
+        f"window: {agg['window']:.4f}s wall, "
+        f"{agg['coverage'] * 100:.1f}% attributed to {agg['roots']} root "
+        f"span(s) ({agg['spans']} spans buffered, {agg['dropped']} dropped)"
+    )
+
+    if agg["by_verb"]:
+        lines.append("")
+        lines.append("verbs:")
+        for name, v in sorted(
+            agg["by_verb"].items(), key=lambda kv: -kv[1]["seconds"]
+        ):
+            rows = f"  rows={int(v['rows'])}" if v["rows"] else ""
+            lines.append(
+                f"  {name:<28} calls={v['calls']:<4} "
+                f"total={v['seconds']:.4f}s{rows}"
+            )
+    if agg["by_kind"]:
+        lines.append("")
+        lines.append("time by phase (span totals; dispatch is async issue"
+                     " time, not device occupancy):")
+        for kind, k in sorted(
+            agg["by_kind"].items(), key=lambda kv: -kv[1]["seconds"]
+        ):
+            lines.append(
+                f"  {kind:<10} {k['seconds']:.4f}s ({k['count']} span(s))"
+            )
+    if agg["by_program"]:
+        lines.append("")
+        lines.append("programs (by graph fingerprint):")
+        for prog, p in sorted(
+            agg["by_program"].items(),
+            key=lambda kv: -(kv[1]["compile_s"] + kv[1]["execute_s"]),
+        ):
+            lines.append(
+                f"  {prog:<16} compile={p['compile_s']:.4f}s "
+                f"({p['compiles']}x)  execute={p['execute_s']:.4f}s "
+                f"({p['dispatches']} dispatch(es))  "
+                f"host_sync={p['host_sync_s']:.4f}s"
+            )
+
+    # executor + recompile-storm signal ---------------------------------
+    try:
+        es = executor_stats(executor)
+        lines.append("")
+        lines.append(
+            "executor: "
+            + " ".join(f"{k}={v}" for k, v in sorted(es.items()))
+        )
+        from ..runtime.executor import default_executor
+        from .. import config as _config
+
+        ex = executor if executor is not None else default_executor()
+        per_prog = getattr(ex, "program_shape_compiles", None)
+        threshold = _config.get().recompile_warn_shapes
+        if callable(per_prog):
+            shapes = per_prog()
+            worst = max(shapes.values()) if shapes else 0
+            storming = {
+                k: n for k, n in shapes.items() if threshold and n > threshold
+            }
+            if storming:
+                lines.append(
+                    f"recompile storm: {len(storming)} program(s) over "
+                    f"recompile_warn_shapes={threshold}:"
+                )
+                for key, n in sorted(storming.items(), key=lambda kv: -kv[1]):
+                    lines.append(
+                        f"  {key[0]}/{str(key[1])[:12]}: {n} compiled shapes"
+                    )
+            else:
+                lines.append(
+                    f"recompile storm: none (max {worst} shape(s)/program, "
+                    f"threshold {threshold})"
+                )
+    except Exception as e:  # diagnostics must never raise
+        lines.append(f"executor stats unavailable: {type(e).__name__}: {e}")
+
+    gauges = _registry.gauge_values()
+    if gauges:
+        lines.append("")
+        lines.append("gauges:")
+        for (name, labels), v in sorted(gauges.items()):
+            lab = _prom_labels(labels)
+            lines.append(f"  {name}{lab} = {v:g}")
+    return "\n".join(lines)

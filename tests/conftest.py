@@ -23,3 +23,18 @@ jax.config.update("jax_platforms", "cpu")
 from tensorframes_tpu.utils.virtual_mesh import force_virtual_cpu_devices
 
 force_virtual_cpu_devices(8)
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Span/metric state never leaks across tests (the telemetry
+    analogue of the `reset_stats()` discipline stats-asserting tests
+    already follow): every test ends with a full `telemetry.reset()` —
+    spans, counters, gauges, histograms — so a test that asserts on the
+    ring or the registry always starts from the previous test's reset."""
+    yield
+    from tensorframes_tpu.utils import telemetry
+
+    telemetry.reset()

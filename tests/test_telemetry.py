@@ -1,0 +1,408 @@
+"""Structured tracing + metrics (`utils.telemetry`).
+
+The observability subsystem's contract tests: span nesting and parent
+links, bounded ring-buffer memory, thread-safety under an 8-thread
+hammer, exporter formats (Chrome trace-event JSON round-trip, Prometheus
+text), the `diagnostics()` wall-time attribution on a chained lazy
+map→reduce (the acceptance scenario), near-zero behavior when disabled,
+and the honest `executor_stats()` fallback for executors that cannot
+count jit shape specializations.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config, dsl
+from tensorframes_tpu.utils import telemetry as tele
+from tensorframes_tpu.utils.inspection import executor_stats
+from tensorframes_tpu.utils.profiling import record, reset_stats, stats
+
+N_THREADS = 8
+ITERS = 200
+
+
+def _run_threads(target, n=N_THREADS):
+    """tests/test_threading.py's harness: barrier start, first worker
+    exception re-raised."""
+    barrier = threading.Barrier(n)
+    errors = []
+
+    def wrap(i):
+        try:
+            barrier.wait(timeout=30)
+            target(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced to pytest
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "worker thread hung"
+    if errors:
+        raise errors[0]
+
+
+class TestSpans:
+    def test_nesting_and_parent_links(self):
+        tele.reset()
+        with tele.span("outer", kind="verb") as outer_id:
+            with tele.span("inner", kind="stage") as inner_id:
+                pass
+        ss = {s.name: s for s in tele.spans()}
+        assert ss["inner"].parent_id == outer_id
+        assert ss["outer"].parent_id is None
+        assert inner_id != outer_id
+        # the parent's window contains the child's
+        assert ss["outer"].t0 <= ss["inner"].t0
+        assert ss["outer"].t1 >= ss["inner"].t1
+
+    def test_disabled_records_nothing_but_counters_stay_live(self):
+        tele.reset()
+        reset_stats()
+        with config.override(telemetry=False):
+            df = tfs.TensorFrame.from_dict({"x": np.arange(6.0)})
+            z = (tfs.block(df, "x") + 1.0).named("z")
+            tfs.map_blocks(z, df)
+        assert tele.spans() == []
+        s = stats()
+        assert s["map_blocks.calls"] == 1  # legacy counters unaffected
+        assert s["map_blocks.rows"] == 6
+
+    def test_error_span_still_recorded_with_error_attr(self):
+        tele.reset()
+        with pytest.raises(ValueError):
+            with tele.span("boom", kind="stage"):
+                raise ValueError("x")
+        (s,) = tele.spans()
+        assert s.attrs["error"] == "ValueError"
+
+    def test_verb_span_nests_block_dispatches_with_program(self):
+        tele.reset()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(40.0)}, num_blocks=4
+        )
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        tfs.map_blocks(z, df)
+        ss = tele.spans()
+        verbs = [s for s in ss if s.kind == "verb"]
+        dispatches = [s for s in ss if s.kind == "dispatch"]
+        assert len(verbs) == 1 and verbs[0].name == "map_blocks"
+        assert len(dispatches) == 4  # one per block
+        for d in dispatches:
+            assert d.parent_id == verbs[0].span_id
+            assert d.attrs["program"]  # graph fingerprint label
+
+    def test_lazy_force_and_stream_chunks_attribute_to_spans(self):
+        tele.reset()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(20.0)}, num_blocks=2
+        )
+        lf = df.lazy().map_blocks((tfs.block(df, "x") + 1.0).named("y"))
+        lf.force()
+        names = [s.name for s in tele.spans()]
+        assert "lazy.force" in names
+        assert "lazy.force.block" in names
+        # stream chunks record too (previously bypassed profiling)
+        tele.reset()
+        proto = tfs.TensorFrame.from_dict({"x": np.ones(4)})
+        x_input = tfs.block(proto, "x", tf_name="x_input")
+        s = dsl.reduce_sum(x_input, axes=[0]).named("x")
+        chunks = (
+            tfs.TensorFrame.from_dict({"x": np.ones(4)}) for _ in range(3)
+        )
+        tfs.reduce_blocks_stream(s, chunks)
+        names = [sp.name for sp in tele.spans()]
+        assert names.count("reduce_blocks_stream.chunk") == 3
+
+
+class TestRingBuffer:
+    def test_bounded_memory_and_dropped_count(self):
+        with config.override(telemetry_ring_entries=64):
+            tele.reset()  # ring rebuilt at the overridden bound
+            for i in range(500):
+                with tele.span(f"s{i}"):
+                    pass
+            assert len(tele.spans()) == 64
+            assert tele.spans_dropped() == 500 - 64
+            # the freshest spans survive, the oldest fell off
+            assert tele.spans()[-1].name == "s499"
+        tele.reset()
+
+    def test_compile_spans_recorded_on_fresh_executor(self):
+        tele.reset()
+        ex = tfs.Executor()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(8.0)})
+        z = (tfs.block(df, "x") + 3.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        kinds = {s.kind for s in tele.spans()}
+        assert "compile" in kinds
+        phases = {
+            s.attrs.get("phase")
+            for s in tele.spans()
+            if s.kind == "compile"
+        }
+        # both the cache-miss trace phase and the per-shape XLA phase
+        assert {"trace", "xla"} <= phases
+
+
+class TestConcurrency:
+    def test_counters_exact_under_8_threads(self):
+        tele.reset()
+
+        def work(i):
+            for _ in range(ITERS):
+                tele.counter_inc("hammer.total")
+                tele.counter_inc("hammer.labeled", 2.0, worker=i % 2)
+                tele.histogram_observe("block_rows", float(i + 1))
+
+        _run_threads(work)
+        s = stats()
+        assert s["hammer.total"] == N_THREADS * ITERS
+        assert (
+            s["hammer.labeled{worker=0}"] + s["hammer.labeled{worker=1}"]
+            == 2.0 * N_THREADS * ITERS
+        )
+        _, _, hists = tele.metrics_snapshot()
+        (key,) = [k for k in hists if k[0] == "block_rows"]
+        _, counts, hsum, hcount = hists[key]
+        assert hcount == sum(counts) == N_THREADS * ITERS
+
+    def test_spans_from_8_threads_bounded_and_well_formed(self):
+        with config.override(telemetry_ring_entries=256):
+            tele.reset()
+
+            def work(i):
+                for k in range(ITERS):
+                    with tele.span(f"t{i}", kind="verb"):
+                        with tele.span(f"t{i}.child", kind="dispatch"):
+                            pass
+
+            _run_threads(work)
+            ss = tele.spans()
+            assert len(ss) <= 256  # bounded no matter the volume
+            by_id = {s.span_id: s for s in ss}
+            for s in ss:
+                # a parent link is either absent or points to an OLDER
+                # span id; when the parent survived eviction it must be
+                # the same thread and its window must contain the child
+                if s.parent_id is None:
+                    continue
+                assert s.parent_id < s.span_id
+                p = by_id.get(s.parent_id)
+                if p is not None:
+                    assert p.thread == s.thread
+                    assert p.t0 <= s.t0 and p.t1 >= s.t1
+        tele.reset()
+
+    def test_concurrent_verbs_do_not_cross_parent(self):
+        tele.reset()
+
+        def work(i):
+            df = tfs.TensorFrame.from_dict(
+                {"x": np.arange(24.0) * (i + 1)}, num_blocks=3
+            )
+            z = (tfs.block(df, "x") + float(i)).named("z")
+            for _ in range(4):
+                tfs.map_blocks(z, df)
+
+        _run_threads(work, n=4)
+        ss = tele.spans()
+        by_id = {s.span_id: s for s in ss}
+        for s in ss:
+            if s.kind == "dispatch" and s.parent_id in by_id:
+                assert by_id[s.parent_id].thread == s.thread
+
+
+class TestExporters:
+    def test_chrome_trace_schema_roundtrip(self, tmp_path):
+        tele.reset()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(30.0)}, num_blocks=3
+        )
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        tfs.map_blocks(z, df)
+        path = str(tmp_path / "trace.json")
+        obj = tele.export_chrome_trace(path)
+        with open(path) as f:
+            loaded = json.load(f)
+        assert loaded == obj  # round-trip: what's returned is what's on disk
+        events = loaded["traceEvents"]
+        assert events, "trace must be non-empty"
+        for ev in events:
+            assert ev["ph"] == "X"
+            for k in ("name", "cat", "ts", "dur", "pid", "tid", "args"):
+                assert k in ev
+        # verb -> dispatch nesting survives via args span/parent ids
+        verb = [e for e in events if e["cat"] == "verb"][0]
+        dispatches = [e for e in events if e["cat"] == "dispatch"]
+        assert dispatches
+        for d in dispatches:
+            assert d["args"]["parent_id"] == verb["args"]["span_id"]
+            # timestamp containment = what the trace viewer nests by
+            assert verb["ts"] <= d["ts"]
+            assert verb["ts"] + verb["dur"] >= d["ts"] + d["dur"]
+
+    def test_prometheus_text_format(self):
+        tele.reset()
+        reset_stats()
+        tele.counter_inc("demo.count", 3)
+        tele.histogram_observe("verb_seconds", 0.002, verb="map_blocks")
+        tele.gauge_set("stream_queue_depth", 2)
+        text = tele.export_prometheus()
+        assert "# TYPE tfs_demo_count counter" in text
+        assert "tfs_demo_count 3" in text
+        assert "# TYPE tfs_verb_seconds histogram" in text
+        assert 'tfs_verb_seconds_bucket{verb="map_blocks",le="+Inf"} 1' in text
+        assert 'tfs_verb_seconds_count{verb="map_blocks"} 1' in text
+        assert "# TYPE tfs_stream_queue_depth gauge" in text
+        # built-in process gauges ride along
+        assert "tfs_executor_cache_entries" in text
+
+    def test_histogram_bucket_monotone_cumulative(self):
+        tele.reset()
+        for v in (0.5, 3.0, 100.0, 1e9):
+            tele.histogram_observe("block_rows", v)
+        text = tele.export_prometheus()
+        cum = [
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("tfs_block_rows_bucket")
+        ]
+        assert cum == sorted(cum)
+        assert cum[-1] == 4  # +Inf bucket sees everything
+
+
+class TestDiagnostics:
+    def test_lazy_chain_attributes_wall_time(self):
+        """The acceptance scenario: a chained lazy map→reduce over a
+        multi-block frame. diagnostics() must attribute >=95% of the
+        span window to named root spans and carry a per-program table
+        distinguishing compile from execute time."""
+        tele.reset()
+        ex = tfs.Executor()  # fresh: the traced run includes compiles
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(60.0, dtype=np.float32)}, num_blocks=4
+        )
+        with tfs.lazy():
+            m1 = tfs.map_blocks(
+                (tfs.block(df, "x") * 2.0).named("y"), df, executor=ex
+            )
+            m2 = tfs.map_blocks(
+                (tfs.block(m1, "y") + 1.0).named("z"), m1, executor=ex
+            )
+            z_in = tfs.block(m2, "z", tf_name="z_input")
+            total = tfs.reduce_blocks(
+                dsl.reduce_sum(z_in, axes=[0]).named("z"), m2, executor=ex
+            )
+        assert abs(float(np.asarray(total)) - float(
+            (np.arange(60.0) * 2 + 1).sum()
+        )) < 1e-3
+        agg = tele.span_aggregates()
+        assert agg["coverage"] >= 0.95, agg
+        assert agg["by_program"], "program attribution table is empty"
+        some = next(iter(agg["by_program"].values()))
+        assert {"compile_s", "execute_s", "host_sync_s"} <= set(some)
+        # at least one program saw both a compile and a dispatch
+        assert any(
+            p["compiles"] > 0 and p["dispatches"] > 0
+            for p in agg["by_program"].values()
+        )
+        report = tfs.diagnostics(ex)
+        assert "attributed" in report
+        assert "programs (by graph fingerprint):" in report
+        assert "recompile storm" in report
+
+    def test_diagnostics_never_raises_when_empty(self):
+        tele.reset()
+        out = tfs.diagnostics()
+        assert "tensorframes-tpu diagnostics" in out
+
+    def test_host_sync_span_recorded_at_materialization(self):
+        tele.reset()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(10.0, dtype=np.float32)}
+        ).to_device()
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        out = tfs.map_blocks(z, df)
+        out.column("z").host_values()
+        kinds = [s.kind for s in tele.spans()]
+        assert "host_sync" in kinds
+        assert "transfer" in kinds  # the to_device H2D leaf
+        _, _, hists = tele.metrics_snapshot()
+        assert any(k[0] == "d2h_bytes" for k in hists)
+        assert any(k[0] == "h2d_bytes" for k in hists)
+
+
+class TestReset:
+    def test_reset_clears_everything_but_registered_gauges(self):
+        tele.reset()
+        tele.counter_inc("x")
+        tele.gauge_set("y", 1.0)
+        tele.histogram_observe("block_rows", 5.0)
+        with tele.span("s"):
+            pass
+        tele.reset()
+        assert tele.spans() == []
+        counters, gauges, hists = tele.metrics_snapshot()
+        assert counters == {}
+        assert hists == {}
+        # built-in registered gauges survive (they read live state)
+        assert ("executor_cache_entries", ()) in gauges
+
+
+class TestExecutorStatsHonesty:
+    def test_stub_without_shape_compiles_gets_estimated_flag(self):
+        class Stub:
+            compile_count = 7
+            cache_hits = 1
+            cache_misses = 2
+            _cache = {}
+
+        s = executor_stats(Stub())
+        # compile_count must NOT leak into jit_shape_compiles anymore
+        assert s["jit_shape_compiles"] == 0
+        assert s["jit_shape_compiles_estimated"] is True
+        assert s["compile_count"] == 7
+
+    def test_real_executor_has_no_flag(self):
+        ex = tfs.Executor()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(4.0)})
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        s = executor_stats(ex)
+        assert "jit_shape_compiles_estimated" not in s
+        assert s["jit_shape_compiles"] >= 1
+
+    def test_native_executor_parity(self):
+        """NativeExecutor implements jit_shape_compiles (== its
+        compile_count), so it reports the exact key set with no
+        estimated flag — parity with the in-process executor."""
+        from tensorframes_tpu.runtime.native_executor import NativeExecutor
+
+        ex = NativeExecutor.for_host(object())  # host never touched here
+        s = executor_stats(ex)
+        assert "jit_shape_compiles_estimated" not in s
+        assert s["jit_shape_compiles"] == s["compile_count"] == 0
+        assert set(s) == {
+            "compile_count", "cache_hits", "cache_misses", "cache_entries",
+            "jit_shape_compiles",
+        }
+
+    def test_program_shape_compiles_per_program(self):
+        ex = tfs.Executor()
+        df = tfs.TensorFrame.from_dict({"x": np.arange(30.0)})
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        with config.override(shape_bucketing=False):
+            for nb in (1, 2, 3):
+                tfs.map_blocks(z, df.repartition(nb), executor=ex)
+        per = ex.program_shape_compiles()
+        assert sum(per.values()) == ex.jit_shape_compiles()
+        # 3 repartitions -> 3 distinct block shapes of ONE program
+        (key,) = [k for k in per if k[0] == "block"]
+        assert per[key] == 3

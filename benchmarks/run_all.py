@@ -55,6 +55,10 @@ SMOKE_SIZES = {
     "BUCKET_BASE": "5",
     "BUCKET_STEP": "3",
     "BUCKET_ITERS": "1",
+    "SCHED_ROWS": "200000",
+    "SCHED_BLOCKS": "8",
+    "SCHED_ITERS": "2",
+    "SCHED_CHAIN": "16",
 }
 
 
@@ -79,9 +83,10 @@ def main():
         "frozen_inception_v3_bench",
         "ragged_map_rows_bench",
         "stream_overlap_bench",
-        # LAST: on a 1-CPU-device host this retargets the process to a
-        # virtual 8-device mesh (clear_backends), which must not leak
-        # into any bench that runs after it
+        # LAST TWO: on a 1-CPU-device host these retarget the process to
+        # a virtual 8-device mesh (clear_backends), which must not leak
+        # into any bench that runs before them
+        "scheduler_bench",
         "train_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")

@@ -242,6 +242,7 @@ def _aggregate_segment(
     feed_names: List[str],
     mapping: Dict[str, str],
     grouped: GroupedFrame,
+    devices=None,
 ) -> TensorFrame:
     """Sort-free keyed aggregation for classified monoid graphs.
 
@@ -340,6 +341,22 @@ def _aggregate_segment(
         else np.zeros(0, np.int32)
     )
     feeds = [frame.column(mapping[n]).values for n in feed_names]
+    # the segment plan is ONE whole-frame dispatch — there is no block
+    # fan-out to spread, so the scheduler only matters as an explicit
+    # placement pin: devices=[d, ...] commits the dispatch to the first
+    # listed device (auto scheduling leaves it on the default device)
+    dev_label = None
+    if devices is not None:  # [] must hit resolve()'s loud rejection too
+        from .runtime import scheduler as _rs
+
+        devs = _rs.resolve(devices=devices, executor=ex)
+        if devs is not None:
+            target = devs[0]
+            gid = jax.device_put(gid, target)
+            counts = jax.device_put(counts, target)
+            feeds = [jax.device_put(f, target) for f in feeds]
+            dev_label = _rs.device_label(target)
+            _rs._bump(ex, "device_dispatches", dev_label, 1)
     from .utils import telemetry as _tele
 
     with _tele.span(
@@ -347,7 +364,7 @@ def _aggregate_segment(
     ):
         with _tele.dispatch_span(
             "aggregate.segment", program=graph.fingerprint(),
-            rows=frame.nrows, groups=num_groups,
+            rows=frame.nrows, groups=num_groups, device=dev_label,
         ):
             outs = sfn(gid, counts, *feeds)
     maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
@@ -399,6 +416,8 @@ def _aggregate_chunked(
     combiners: Dict[str, str],
     pad_quantum: int = 1,
     program: Optional[str] = None,
+    executor=None,
+    devices=None,
 ) -> Dict[str, np.ndarray]:
     """Keyed aggregation by pow2 chunk decomposition + monoid combine.
 
@@ -453,16 +472,32 @@ def _aggregate_chunked(
     #    reduce verbs); the scatter into the flat table then drains them.
     from .utils import telemetry as _tele
 
+    # block-scheduler fan-out: the per-chunk-size programs are
+    # independent dispatches, so they spread across local devices
+    # weighted by their total row volume (mesh callers pass no
+    # executor/devices and stay unscheduled — the mesh owns placement)
+    chunk_ps = sorted(chunk_starts_by_p, reverse=True)
+    sched = None
+    if executor is not None or devices is not None:
+        from .runtime import scheduler as _rs
+
+        sched = _rs.schedule_weights(
+            [len(chunk_starts_by_p[p]) * p for p in chunk_ps],
+            devices=devices, executor=executor,
+        )
     pending = []
-    for p in sorted(chunk_starts_by_p, reverse=True):
+    for pi, p in enumerate(chunk_ps):
         starts_list = chunk_starts_by_p[p]
         n_p = len(starts_list)
         padded = _padded(n_p)
         st = np.asarray(starts_list + [starts_list[-1]] * (padded - n_p))
         row_idx = st[:, None] + np.arange(p)[None, :]
         feeds = [col_data[n][row_idx] for n in feed_names]
+        if sched is not None:
+            feeds = sched.put(pi, feeds)
         with _tele.dispatch_span(
-            "aggregate.chunk", program=program, rows=n_p * p, size=p
+            "aggregate.chunk", program=program, rows=n_p * p, size=p,
+            device=sched.label(pi) if sched is not None else None,
         ):
             outs = run(feeds)
         maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")

@@ -356,21 +356,131 @@ def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
         return tuple(cfn(tuple(partials)))
 
 
-def _concat_parts(parts: List) -> "np.ndarray":
+def _assoc_reduce(graph, fetch_list, summary) -> bool:
+    """True when re-feeding partials through ``graph`` is an associative
+    monoid combine (sum/min/max/prod consuming its placeholder
+    DIRECTLY) — the class whose partials may fold hierarchically. Mean
+    and transform-then-reduce graphs re-weight/re-apply under nesting
+    (the same gate `reduce_blocks_stream` uses before tree-folding)."""
+    from .aggregate import _chunk_combiners
+
+    comb = _chunk_combiners(graph, fetch_list, summary, require_direct=True)
+    return comb is not None and "mean" not in comb.values()
+
+
+def _combine_partials_scheduled(
+    ex, kind, graph, fetch_list, feed_names, build, partials, owners,
+    sched, assoc,
+):
+    """Combine per-block partials under the block scheduler.
+
+    ``assoc`` graphs (see `_assoc_reduce`) fold each device's partials
+    LOCALLY first, then one final cross-device combine over the
+    per-device results on the anchor device — transfer volume O(ndev)
+    instead of O(blocks), and every step is an async device op (host
+    syncs do not grow). Results are bit-identical for min/max under any
+    grouping; float sum stays within the documented reassociation
+    tolerance. Non-associative graphs (mean, transform-then-reduce,
+    unclassified — and reduce_rows folds, whose left-fold-in-block-order
+    contract admits no regrouping) gather ALL partials onto the anchor
+    (async D2D) and run the single combine in block order, bit-identical
+    to the unscheduled verb."""
+    groups: Dict[int, List[Tuple]] = {}
+    for p, o in zip(partials, owners):
+        groups.setdefault(o, []).append(p)
+    anchor = sched.anchor_device()
+    if assoc and len(groups) > 1:
+        stage: List[Tuple] = []
+        for slot in sorted(groups):
+            parts = groups[slot]
+            stage.append(
+                parts[0]
+                if len(parts) == 1
+                else _combine_partials(
+                    ex, kind, graph, fetch_list, feed_names, build, parts
+                )
+            )
+        moved = [
+            tuple(jax.device_put(x, anchor) for x in p) for p in stage
+        ]
+        return _combine_partials(
+            ex, kind, graph, fetch_list, feed_names, build, moved
+        )
+    # gather unconditionally, not only when owners span several slots: a
+    # reduce_rows single-row partial is a column SLICE whose actual
+    # device is the column's home, not its nominal slot, so owners alone
+    # cannot prove colocation (device_put to the current device is free)
+    partials = [
+        tuple(jax.device_put(x, anchor) for x in p) for p in partials
+    ]
+    return _combine_partials(
+        ex, kind, graph, fetch_list, feed_names, build, partials
+    )
+
+
+def _colocate_parts(parts: List, anchor=None) -> List:
+    """Move parts spanning several devices onto one anchor device so a
+    single jnp op can consume them (jax refuses committed arrays from
+    different devices in one computation). The block scheduler's map
+    outputs and stream partials hit this; everything is `device_put`
+    (async D2D/H2D) — no host sync.
+
+    ``anchor`` (a jax device) is the scheduler's anchor: scheduled verbs
+    MUST pass it so every call over the same device set commits its
+    output to the SAME device — per-call anchors (e.g. most-rows) would
+    leave one frame's columns committed to different devices, and any
+    later dispatch feeding two such columns into one jit call (the
+    segment-plan aggregate, or any verb after turning the scheduler
+    off) would crash on jax's incompatible-devices check. (Chaining
+    verbs with *different* explicit ``devices=`` pins still produces
+    mixed commitments — that is the user's deliberate placement, see
+    ARCHITECTURE.md "Output coherence".) Without an anchor (unscheduled
+    callers over user-mixed inputs), the device already holding the
+    most rows wins (first seen breaks ties), minimizing transfer."""
+    weight: Dict = {}
+    devs: List = []
+    for p in parts:
+        d = None
+        if isinstance(p, jax.Array):
+            try:
+                ds = p.devices()
+                d = next(iter(ds)) if len(ds) == 1 else None
+            except Exception:
+                d = None
+        devs.append(d)
+        if d is not None:
+            rows = p.shape[0] if getattr(p, "ndim", 0) else 1
+            weight[d] = weight.get(d, 0) + rows
+    if len(weight) <= 1:
+        return list(parts)
+    if anchor is None:
+        anchor = max(weight.items(), key=lambda kv: kv[1])[0]
+    return [
+        p if d is anchor else jax.device_put(p, anchor)
+        for p, d in zip(parts, devs)
+    ]
+
+
+def _concat_parts(parts: List, anchor=None) -> "np.ndarray":
     """Concatenate block outputs, staying on device when the parts are
-    device arrays (no host round-trip for device-resident frames)."""
+    device arrays (no host round-trip for device-resident frames;
+    cross-device parts converge via `_colocate_parts` first — scheduled
+    callers pass their schedule's anchor device)."""
     if len(parts) == 1:
         return parts[0]
     if any(isinstance(p, jax.Array) for p in parts):
         import jax.numpy as jnp
 
-        return jnp.concatenate([jnp.asarray(p) for p in parts])
+        return jnp.concatenate(
+            [jnp.asarray(p) for p in _colocate_parts(parts, anchor)]
+        )
     return np.concatenate(parts)
 
 
-def _stack_parts(parts: List) -> "np.ndarray":
-    """Stack partials: on device when any is a `jax.Array`, else with
-    host numpy. The host branch matters beyond convenience — for
+def _stack_parts(parts: List, anchor=None) -> "np.ndarray":
+    """Stack partials: on device when any is a `jax.Array` (cross-device
+    partials converge via `_colocate_parts` first), else with host
+    numpy. The host branch matters beyond convenience — for
     native-executor partials (host numpy), a `jnp.stack` would
     initialize the in-process JAX backend next to a native host that
     may own the same device (the double-client hazard `NativeExecutor`
@@ -378,7 +488,9 @@ def _stack_parts(parts: List) -> "np.ndarray":
     if any(isinstance(p, jax.Array) for p in parts):
         import jax.numpy as jnp
 
-        return jnp.stack([jnp.asarray(p) for p in parts])
+        return jnp.stack(
+            [jnp.asarray(p) for p in _colocate_parts(parts, anchor)]
+        )
     return np.stack([np.asarray(p) for p in parts])
 
 
@@ -533,6 +645,7 @@ def map_blocks(
     executor: Optional[Executor] = None,
     mesh=None,
     bindings: Optional[Dict[str, "np.ndarray"]] = None,
+    devices=None,
 ) -> TensorFrame:
     """Apply a graph to each block; one jitted XLA call per block.
 
@@ -542,6 +655,12 @@ def map_blocks(
     device mesh (see `parallel.verbs`). ``bindings`` feeds named
     placeholders a per-call array instead of a column — updates between
     calls do NOT recompile (see `_check_bindings`).
+
+    Without a mesh, per-block dispatches spread across
+    ``jax.local_devices()`` under the block scheduler
+    (`runtime.scheduler`; ``config.block_scheduler``, default auto-on
+    when >1 local device). ``devices=`` pins the dispatch to an explicit
+    device list (one device = pinning); mesh= takes precedence.
 
     On a `LazyFrame` — or on a plain frame under ``with tfs.lazy():``
     with graph fetches (function/``trim``/``bindings`` calls stay
@@ -555,7 +674,7 @@ def map_blocks(
         return frame.map_blocks(
             fetches, feed_dict=feed_dict, trim=trim,
             fetch_names=fetch_names, executor=executor, mesh=mesh,
-            bindings=bindings,
+            bindings=bindings, devices=devices,
         )
     if (
         lazy_active()
@@ -574,7 +693,9 @@ def map_blocks(
             # _fuse_stage directly: the graph is already normalized
             # (functionalized + frozen), and re-running _as_graph on it
             # would pay that pass twice per deferred call
-            return LazyFrame(frame, executor=executor, mesh=mesh)._fuse_stage(
+            return LazyFrame(
+                frame, executor=executor, mesh=mesh, devices=devices
+            )._fuse_stage(
                 "map_blocks", lazy_graph, lazy_fetches, feed_dict
             )
         # bytes pass-through cannot splice: stay eager under the mode
@@ -589,7 +710,7 @@ def map_blocks(
             )
         return _map_blocks_fn(
             fetches, frame, trim, executor or default_executor(),
-            bindings=bindings,
+            bindings=bindings, devices=devices,
         )
     graph, fetch_list = _as_graph(fetches, fetch_names)
     graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
@@ -605,7 +726,7 @@ def map_blocks(
         if fetch_list:
             dev = map_blocks(
                 graph, frame, feed_dict, False, fetch_list, executor,
-                mesh=mesh, bindings=bindings,
+                mesh=mesh, bindings=bindings, devices=devices,
             )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
@@ -659,8 +780,10 @@ def map_blocks(
         )
     )
 
+    from .runtime import scheduler as _rs
     from .utils import telemetry as _tele
 
+    sched = _rs.schedule_for(frame, devices=devices, executor=ex)
     fp = graph.fingerprint()
     acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
     out_sizes: List[int] = []
@@ -686,12 +809,14 @@ def map_blocks(
         from . import config as _config
         from .runtime.retry import run_with_retries
 
+        call = sched.bind(bi, fn) if sched is not None else fn
         with _tele.dispatch_span(
             "map_blocks.block", program=fp, block=bi, rows=hi - lo,
             bucket=bucket if bucketed else None,
+            device=sched.label(bi) if sched is not None else None,
         ):
             outs = run_with_retries(
-                fn, *feeds,
+                call, *feeds,
                 attempts=_config.get().block_retry_attempts,
                 what=f"map_blocks block {bi}",
             )
@@ -721,12 +846,13 @@ def map_blocks(
             acc[_base(f)].append(o)
         out_sizes.append(bsize if trim else hi - lo)
 
+    anchor = sched.anchor_device() if sched is not None else None
     out_cols = []
     for f in fetch_list:
         base = _base(f)
         parts = acc[base]
         data = (
-            _concat_parts(parts)
+            _concat_parts(parts, anchor)
             if parts
             else _empty_output(summary, base, drop_lead=True)
         )
@@ -756,6 +882,7 @@ def map_rows(
     executor: Optional[Executor] = None,
     mesh=None,
     bindings: Optional[Dict[str, "np.ndarray"]] = None,
+    devices=None,
 ) -> TensorFrame:
     """Apply a graph independently to every row.
 
@@ -787,7 +914,9 @@ def map_rows(
                 fetches, frame, mesh, feed_dict, fetch_names, executor,
                 bindings=bindings,
             )
-        return _map_rows_fn(fetches, frame, ex, bindings=bindings)
+        return _map_rows_fn(
+            fetches, frame, ex, bindings=bindings, devices=devices
+        )
     graph, fetch_list = _as_graph(fetches, fetch_names)
     graph, fetch_list, str_pass = _split_string_passthrough(graph, fetch_list)
     if str_pass:
@@ -797,7 +926,7 @@ def map_rows(
         if fetch_list:
             dev = map_rows(
                 graph, frame, feed_dict, fetch_list, executor,
-                mesh=mesh, bindings=bindings,
+                mesh=mesh, bindings=bindings, devices=devices,
             )
             dev_cols = [dev.column(_base(f)) for f in fetch_list]
         else:
@@ -857,26 +986,41 @@ def map_rows(
                 )
             ),
         )
+        # per-block dispatches spread across local devices like
+        # map_blocks; outputs stay device-resident per block and
+        # `_concat_parts` below concatenates ON DEVICE (colocating
+        # cross-device parts), so a chained verb never pays a hidden
+        # per-block D2H sync
+        from .runtime import scheduler as _rs
+        from .utils import telemetry as _tele
+
+        sched = _rs.schedule_for(frame, devices=devices, executor=ex)
+        fp = graph.fingerprint()
         acc: Dict[str, List[np.ndarray]] = {n: [] for n in out_names}
         for bi in range(frame.num_blocks):
             lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
             if lo == hi:
                 continue
-            outs = vfn(
-                *[
-                    bindings[p]
-                    if p in bindings
-                    else frame.column(mapping[p]).values[lo:hi]
-                    for p in params
-                ]
-            )
+            feeds = [
+                bindings[p]
+                if p in bindings
+                else frame.column(mapping[p]).values[lo:hi]
+                for p in params
+            ]
+            call = sched.bind(bi, vfn) if sched is not None else vfn
+            with _tele.dispatch_span(
+                "map_rows.block", program=fp, block=bi, rows=hi - lo,
+                device=sched.label(bi) if sched is not None else None,
+            ):
+                outs = call(*feeds)
             maybe_check_numerics(out_names, outs, f"map_rows block {bi}")
             for n, o in zip(out_names, outs):
                 acc[n].append(o)
+        anchor = sched.anchor_device() if sched is not None else None
         out_cols = [
             Column(
                 n,
-                _concat_parts(parts)
+                _concat_parts(parts, anchor)
                 if parts
                 else _empty_output(summary, n, drop_lead=False),
             )
@@ -970,6 +1114,7 @@ def reduce_blocks(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
+    devices=None,
 ):
     """Per-block reduce, then one on-device combine over stacked partials.
 
@@ -995,7 +1140,7 @@ def reduce_blocks(
 
     if isinstance(frame, LazyFrame):
         return frame.reduce_blocks(
-            fetches, feed_dict, fetch_names, executor, mesh
+            fetches, feed_dict, fetch_names, executor, mesh, devices=devices
         )
     if mesh is not None:
         from .parallel import verbs as _pverbs
@@ -1042,10 +1187,13 @@ def reduce_blocks(
     # `DataOps.scala:63-81`). maybe_check_numerics is a no-op unless the
     # debug mode is on, in which case it deliberately syncs per block to
     # name the offender.
+    from .runtime import scheduler as _rs
     from .utils import telemetry as _tele
 
+    sched = _rs.schedule_for(frame, devices=devices, executor=ex)
     fp = graph.fingerprint()
     partials: List[Tuple] = []
+    owners: List[int] = []  # device slot per partial (scheduled runs)
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
         if lo == hi:
@@ -1057,13 +1205,21 @@ def reduce_blocks(
         with _tele.dispatch_span(
             "reduce_blocks.block", program=fp, block=bi, rows=hi - lo,
             masked=mask_plan is not None or None,
+            device=sched.label(bi) if sched is not None else None,
         ):
             if mask_plan is not None:
-                outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+                if sched is not None:
+                    pfeeds, _ = _sp.pad_feeds(feeds, hi - lo)
+                    outs = sched.bind(bi, fn, valid=hi - lo)(*pfeeds)
+                else:
+                    outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+            elif sched is not None:
+                outs = sched.bind(bi, fn)(*feeds)
             else:
                 outs = fn(*feeds)
         maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
         partials.append(tuple(outs))
+        owners.append(sched.slot(bi) if sched is not None else 0)
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
     if len(partials) == 1:
@@ -1082,10 +1238,17 @@ def reduce_blocks(
 
             return combine
 
-        final = _combine_partials(
-            ex, "reduce-combine", graph, fetch_list, feed_names,
-            build_block_combine, partials,
-        )
+        if sched is not None:
+            final = _combine_partials_scheduled(
+                ex, "reduce-combine", graph, fetch_list, feed_names,
+                build_block_combine, partials, owners, sched,
+                assoc=_assoc_reduce(graph, fetch_list, summary),
+            )
+        else:
+            final = _combine_partials(
+                ex, "reduce-combine", graph, fetch_list, feed_names,
+                build_block_combine, partials,
+            )
     if len(fetch_list) == 1:
         return final[0]
     return {_base(f): v for f, v in zip(fetch_list, final)}
@@ -1141,6 +1304,7 @@ def reduce_rows(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
+    devices=None,
 ):
     """Pairwise fold over all rows.
 
@@ -1207,11 +1371,25 @@ def reduce_rows(
     )
     # async dispatch, device-resident partials: same discipline as
     # reduce_blocks — every block's fold is in flight before anything
-    # is combined, and nothing is host-fetched on this path at all
+    # is combined, and nothing is host-fetched on this path at all.
+    # Scheduled runs spread the per-block folds across devices; the
+    # FINAL combine always gathers every partial onto the anchor device
+    # and folds them in block order (never hierarchically): the verb's
+    # contract is a left fold in row order, which non-associative
+    # graphs rely on — regrouping by device would break it.
+    from .runtime import scheduler as _rs
     from .utils import telemetry as _tele
 
+    # single-row blocks never dispatch (their partial is a bare column
+    # slice), so they carry zero planning weight — otherwise their slot's
+    # queue-depth ledger would count a dispatch that never drains
+    sched = _rs.schedule_weights(
+        [0 if s == 1 else s for s in frame.block_sizes()],
+        devices=devices, executor=ex,
+    )
     fp = graph.fingerprint()
     partials: List[Tuple] = []
+    owners: List[int] = []
     for bi in range(frame.num_blocks):
         lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
         if lo == hi:
@@ -1219,13 +1397,22 @@ def reduce_rows(
         cols = {b: frame.column(mapping[b + "_1"]).values[lo:hi] for b in bases}
         if hi - lo == 1:
             partials.append(tuple(cols[b][0] for b in bases))
+            owners.append(0)
         else:
             with _tele.dispatch_span(
-                "reduce_rows.block", program=fp, block=bi, rows=hi - lo
+                "reduce_rows.block", program=fp, block=bi, rows=hi - lo,
+                device=sched.label(bi) if sched is not None else None,
             ):
+                if sched is not None:
+                    # dict feeds: device_put the values, keep the keys
+                    keys = list(cols)
+                    cols = dict(
+                        zip(keys, sched.put(bi, [cols[k] for k in keys]))
+                    )
                 outs = jfold(cols)
             maybe_check_numerics(bases, outs, f"reduce_rows block {bi}")
             partials.append(tuple(outs))
+            owners.append(sched.slot(bi) if sched is not None else 0)
     if not partials:
         raise ValueError("reduce_rows on an empty frame")
     if len(partials) == 1:
@@ -1245,10 +1432,16 @@ def reduce_rows(
 
             return combine
 
-        final = _combine_partials(
-            ex, "fold-combine", graph, fetch_list, feed_names,
-            build_fold_combine, partials,
-        )
+        if sched is not None:
+            final = _combine_partials_scheduled(
+                ex, "fold-combine", graph, fetch_list, feed_names,
+                build_fold_combine, partials, owners, sched, assoc=False,
+            )
+        else:
+            final = _combine_partials(
+                ex, "fold-combine", graph, fetch_list, feed_names,
+                build_fold_combine, partials,
+            )
     if len(bases) == 1:
         return final[0]
     return dict(zip(bases, final))
@@ -1308,6 +1501,7 @@ def aggregate(
     fetch_names: Optional[Sequence[str]] = None,
     executor: Optional[Executor] = None,
     mesh=None,
+    devices=None,
 ) -> TensorFrame:
     """Keyed aggregation with reduce_blocks naming conventions.
 
@@ -1350,7 +1544,8 @@ def aggregate(
         # sort-free: one XLA call over all rows + device segment ops
         _count("aggregate.plan.segment")
         return _aggregate_segment(
-            ex, graph, fetch_list, classified, feed_names, mapping, grouped
+            ex, graph, fetch_list, classified, feed_names, mapping, grouped,
+            devices=devices,
         )
 
     key_out, num_groups, counts, starts, col_data = _group_plan(
@@ -1385,21 +1580,31 @@ def aggregate(
         # exact plan: one vmapped call per distinct size, whole groups —
         # no associativity assumption, best for regular key distributions.
         # Two phases: dispatch EVERY per-size program first (partials
-        # stay as device arrays), then scatter into the host result —
-        # the first host fetch happens only after all sizes are in
-        # flight, so per-size device work overlaps instead of
-        # serializing on each size's D2H copy.
+        # stay as device arrays; under the block scheduler the per-size
+        # programs spread across local devices, weighted by their total
+        # row count), then scatter into the host result — the first
+        # host fetch happens only after all sizes are in flight, so
+        # per-size device work overlaps instead of serializing on each
+        # size's D2H copy.
+        from .runtime import scheduler as _rs
+
+        sched = _rs.schedule_weights(
+            [int(s) * int((counts == s).sum()) for s in unique_sizes],
+            devices=devices, executor=ex,
+        )
         pending: List[Tuple[np.ndarray, Tuple]] = []
         with _tele.span("aggregate.plan.exact", kind="stage", program=fp):
-            for size in unique_sizes:
+            for si, size in enumerate(unique_sizes):
                 gids = np.nonzero(counts == size)[0]
                 row_idx = starts[gids][:, None] + np.arange(size)[None, :]
                 feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
+                call = sched.bind(si, vraw) if sched is not None else vraw
                 with _tele.dispatch_span(
                     "aggregate.size", program=fp,
                     rows=int(size) * len(gids), size=int(size),
+                    device=sched.label(si) if sched is not None else None,
                 ):
-                    outs = vraw(*feeds)
+                    outs = call(*feeds)
                 maybe_check_numerics(
                     bases, outs, f"aggregate groups of size {size}"
                 )
@@ -1432,6 +1637,8 @@ def aggregate(
                     bases,
                     combiners,
                     program=fp,
+                    executor=ex,
+                    devices=devices,
                 )
             )
 

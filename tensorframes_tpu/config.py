@@ -89,6 +89,38 @@ class Config:
     # ladder length (compile count ~ log_growth(max rows)).
     shape_bucket_growth: float = 2.0
     shape_bucket_min: int = 8
+    # Multi-device block scheduler (`runtime.scheduler`): non-mesh verbs
+    # spread per-block dispatches across jax.local_devices() (size-aware
+    # largest-first placement; feeds are device_put onto the assigned
+    # device and jit's committed-input semantics place the execution).
+    # Values:
+    #   "auto" — (default) schedule when >1 local device exists
+    #   "on"   — schedule onto all local devices even when there is one
+    #            (forces the scheduled code path — explicit device_put,
+    #            per-device ledgers)
+    #   "off"  — every dispatch lands on the default device (the
+    #            pre-scheduler behavior)
+    # mesh= always takes precedence, and the native executor is never
+    # scheduled (it owns its own PJRT host). Per-call override: the
+    # devices= parameter on every non-mesh verb. Under scheduling, jit
+    # specializes each program per device it touches, so compile counts
+    # are bounded by ndev x the single-device count (ndev x ladder rungs
+    # under shape_bucketing). Reduce combines stay bit-identical for
+    # min/max and within the documented reassociation tolerance for
+    # float sum/mean. Env override TFS_BLOCK_SCHEDULER seeds the initial
+    # value, mirroring TFS_SHAPE_BUCKETING.
+    block_scheduler: str = dataclasses.field(
+        default_factory=lambda: {
+            "0": "off", "false": "off", "1": "on", "true": "on",
+        }.get(
+            __import__("os").environ.get(
+                "TFS_BLOCK_SCHEDULER", "auto"
+            ).lower(),
+            __import__("os").environ.get(
+                "TFS_BLOCK_SCHEDULER", "auto"
+            ).lower(),
+        )
+    )
     # One-time per-program warning when jit has compiled more than this
     # many distinct input shapes for a single cached program — the
     # recompile-storm signal `compile_count` (distinct lowered callables)

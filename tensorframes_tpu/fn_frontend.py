@@ -81,6 +81,7 @@ def _map_blocks_fn(
     trim: bool,
     ex: Executor,
     bindings: Optional[Dict[str, "np.ndarray"]] = None,
+    devices=None,
 ) -> TensorFrame:
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     params = _fn_feed_columns(fn, frame, bound=set(bindings))
@@ -94,6 +95,11 @@ def _map_blocks_fn(
     # ex.jit, not jax.jit: under the native default this compiles
     # through the C++ PJRT host like the graph front-end does
     jfn = ex.jit(lambda *args: _fn_outputs_to_dict(fn(*args), "map_blocks"))
+    # function-front-end dispatches block-schedule exactly like the
+    # graph path (the native executor opts out via supports_scheduling)
+    from .runtime import scheduler as _sched
+
+    sched = _sched.schedule_for(frame, devices=devices, executor=ex)
     acc: Dict[str, List[np.ndarray]] = {}
     out_sizes: List[int] = []
     for bi in range(frame.num_blocks):
@@ -101,7 +107,8 @@ def _map_blocks_fn(
         if lo == hi:
             out_sizes.append(0)
             continue
-        outs = jfn(
+        call = sched.bind(bi, jfn) if sched is not None else jfn
+        outs = call(
             *[
                 bindings[p] if p in bindings else frame.column(p).values[lo:hi]
                 for p in params
@@ -137,7 +144,11 @@ def _map_blocks_fn(
             ],
         )
         acc = {n: [v] for n, v in empties.items()}
-    out_cols = [Column(n, _api._concat_parts(parts)) for n, parts in acc.items()]
+    anchor = sched.anchor_device() if sched is not None else None
+    out_cols = [
+        Column(n, _api._concat_parts(parts, anchor))
+        for n, parts in acc.items()
+    ]
     offsets = list(np.cumsum([0] + out_sizes)) if trim else frame.offsets
     return _api._output_frame(frame, out_cols, append_input=not trim, offsets=offsets)
 
@@ -237,6 +248,7 @@ def _map_rows_fn(
     frame: TensorFrame,
     ex: "Executor",
     bindings: Optional[Dict[str, "np.ndarray"]] = None,
+    devices=None,
 ) -> TensorFrame:
     """Function front-end for map_rows: fn(cell, ...) -> dict of outputs.
 
@@ -279,17 +291,25 @@ def _map_rows_fn(
     if dense:
         in_axes = tuple(None if p in bindings else 0 for p in params)
         vfn = ex.jit(jax.vmap(wrapped, in_axes=in_axes))
+        from .runtime import scheduler as _sched
+
+        sched = _sched.schedule_for(frame, devices=devices, executor=ex)
         for bi in range(frame.num_blocks):
             lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
             if lo == hi:
                 continue
-            outs = vfn(*_feeds(lo, hi))
+            call = sched.bind(bi, vfn) if sched is not None else vfn
+            outs = call(*_feeds(lo, hi))
             for n, o in outs.items():
                 acc.setdefault(n, []).append(o)
         if not acc:
             empties = _empty_fn_outputs(vfn, _feeds(0, 0))
             acc = {n: [v] for n, v in empties.items()}
-        out_cols = [Column(n, _api._concat_parts(parts)) for n, parts in acc.items()]
+        anchor = sched.anchor_device() if sched is not None else None
+        out_cols = [
+            Column(n, _api._concat_parts(parts, anchor))
+            for n, parts in acc.items()
+        ]
     else:
         vfn = ex.jit(jax.vmap(wrapped))
         if frame.nrows == 0:

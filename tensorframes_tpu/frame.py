@@ -471,15 +471,22 @@ class TensorFrame:
         return TensorFrame(cols, self.offsets)
 
     # ---- device placement ----------------------------------------------
-    def to_device(self, mesh=None) -> "TensorFrame":
+    def to_device(self, mesh=None, device=None) -> "TensorFrame":
         """Move dense columns into device HBM (sharded over the mesh's
-        ``data`` axis when a mesh is given). Ragged/string columns stay on
-        host. Verb outputs on a device-resident frame stay on device —
-        host materialization happens only at `to_pandas`/`collect`."""
+        ``data`` axis when a mesh is given; committed onto ``device``
+        when one is given — the block scheduler's streaming prefetch
+        targets each chunk's assigned device this way). Ragged/string
+        columns stay on host. Verb outputs on a device-resident frame
+        stay on device — host materialization happens only at
+        `to_pandas`/`collect`."""
         import jax
 
         from .utils import telemetry as _tele
 
+        if mesh is not None and device is not None:
+            raise ValueError(
+                "to_device: mesh= and device= are mutually exclusive"
+            )
         h2d_bytes = 0
         new_cols = []
         # transfer span: the H2D issue window (device_put is async — the
@@ -500,15 +507,24 @@ class TensorFrame:
                         host = np.asarray(c.values)
                         h2d_bytes += host.nbytes
                         vals = shard_to_mesh(mesh, host)
-                    elif isinstance(c.values, jax.Array) and mesh is None:
+                    elif (
+                        isinstance(c.values, jax.Array)
+                        and mesh is None
+                        and device is None
+                    ):
                         # already device-resident: a device_put here would
                         # round-trip D2H (np.asarray blocks) then re-upload
                         new_cols.append(c)
                         continue
+                    elif (
+                        isinstance(c.values, jax.Array) and device is not None
+                    ):
+                        # device->device commit/move: async, never via host
+                        vals = jax.device_put(c.values, device)
                     else:
                         host = np.asarray(c.values)
                         h2d_bytes += host.nbytes
-                        vals = jax.device_put(host)
+                        vals = jax.device_put(host, device)
                     nc = Column(c.name, vals, c.dtype)
                     nc.cell_shape = c.cell_shape
                     new_cols.append(nc)

@@ -130,6 +130,7 @@ class LazyFrame:
         stages: Optional[List[LazyStage]] = None,
         executor=None,
         mesh=None,
+        devices=None,
     ):
         self._base = base
         self._graph = graph if graph is not None else Graph()
@@ -138,6 +139,7 @@ class LazyFrame:
         self._stages: List[LazyStage] = list(stages or [])
         self._executor = executor
         self._mesh = mesh
+        self._devices = devices  # block-scheduler override for terminals
         self._forced: Optional[TensorFrame] = None
 
     # -- frame-shaped surface (row-aligned with the base) ---------------
@@ -286,6 +288,7 @@ class LazyFrame:
         feed_dict: Optional[Dict[str, str]],
         executor=None,
         mesh=None,
+        devices=None,
     ) -> "LazyFrame":
         from .utils import telemetry as _tele
 
@@ -313,6 +316,7 @@ class LazyFrame:
             self._stages + [stage],
             executor if executor is not None else self._executor,
             mesh if mesh is not None else self._mesh,
+            devices if devices is not None else self._devices,
         )
 
     # -- deferred verbs -------------------------------------------------
@@ -325,6 +329,7 @@ class LazyFrame:
         executor=None,
         mesh=None,
         bindings=None,
+        devices=None,
     ) -> "LazyFrame":
         """Defer a row-preserving block map onto the fused plan."""
         if trim:
@@ -353,7 +358,8 @@ class LazyFrame:
                 "(host-side pass-through cannot fuse); call .force() first"
             )
         return self._fuse_stage(
-            "map_blocks", graph, fetch_list, feed_dict, executor, mesh
+            "map_blocks", graph, fetch_list, feed_dict, executor, mesh,
+            devices,
         )
 
     def map_rows(self, fetches, **kw):
@@ -367,6 +373,7 @@ class LazyFrame:
         fetch_names=None,
         executor=None,
         mesh=None,
+        devices=None,
     ):
         """Terminal action: fuse the reduce's per-block stage into the
         pending graph and run the whole chain as ONE program per block
@@ -375,15 +382,16 @@ class LazyFrame:
         eager verb."""
         executor = executor if executor is not None else self._executor
         mesh = mesh if mesh is not None else self._mesh
+        devices = devices if devices is not None else self._devices
         if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
             return _api.reduce_blocks(
                 fetches, self.force(), feed_dict, fetch_names, executor,
-                mesh=mesh,
+                mesh=mesh, devices=devices,
             )
         if not self._sources:
             return _api.reduce_blocks(
                 fetches, self._base, feed_dict, fetch_names, executor,
-                mesh=mesh,
+                mesh=mesh, devices=devices,
             )
         from .graph.analysis import analyze_graph
         from .runtime.executor import default_executor
@@ -468,10 +476,15 @@ class LazyFrame:
                     )
                 else:
                     fn = ex.callable_for(fused, fused_fetches, feed_names)
+                from .runtime import scheduler as _rs
                 from .utils import telemetry as _tele
 
+                sched = _rs.schedule_for(
+                    frame, devices=devices, executor=ex
+                )
                 fp = fused.fingerprint()
                 partials: List[Tuple] = []
+                owners: List[int] = []
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
                     if lo == hi:
@@ -487,15 +500,27 @@ class LazyFrame:
                         "reduce_blocks.fused.block", program=fp,
                         block=bi, rows=hi - lo,
                         masked=mask_plan is not None or None,
+                        device=sched.label(bi) if sched is not None else None,
                     ):
                         if mask_plan is not None:
-                            outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+                            if sched is not None:
+                                pfeeds, _ = _sp.pad_feeds(feeds, hi - lo)
+                                outs = sched.bind(
+                                    bi, fn, valid=hi - lo
+                                )(*pfeeds)
+                            else:
+                                outs = _sp.dispatch_masked(fn, feeds, hi - lo)
+                        elif sched is not None:
+                            outs = sched.bind(bi, fn)(*feeds)
                         else:
                             outs = fn(*feeds)
                     maybe_check_numerics(
                         rfetch, outs, f"reduce_blocks (fused) block {bi}"
                     )
                     partials.append(tuple(outs))
+                    owners.append(
+                        sched.slot(bi) if sched is not None else 0
+                    )
                 if not partials:
                     raise ValueError("reduce_blocks on an empty frame")
                 if len(partials) == 1:
@@ -517,10 +542,20 @@ class LazyFrame:
 
                         return combine
 
-                    final = _api._combine_partials(
-                        ex, "reduce-combine", rgraph, rfetch, rfeed_names,
-                        build_block_combine, partials,
-                    )
+                    if sched is not None:
+                        final = _api._combine_partials_scheduled(
+                            ex, "reduce-combine", rgraph, rfetch,
+                            rfeed_names, build_block_combine, partials,
+                            owners, sched,
+                            assoc=_api._assoc_reduce(
+                                rgraph, rfetch, rsummary
+                            ),
+                        )
+                    else:
+                        final = _api._combine_partials(
+                            ex, "reduce-combine", rgraph, rfetch,
+                            rfeed_names, build_block_combine, partials,
+                        )
         if len(rfetch) == 1:
             return final[0]
         return {_base(f): v for f, v in zip(rfetch, final)}
@@ -535,13 +570,16 @@ class LazyFrame:
         return _api.GroupedFrame(self.force(), keys)
 
     # -- terminal actions ----------------------------------------------
-    def force(self, executor=None, mesh=None) -> TensorFrame:
+    def force(self, executor=None, mesh=None, devices=None) -> TensorFrame:
         """Lower the whole fused plan as ONE XLA program per block (one
         fused shard_map program with a mesh) and return the concrete
         `TensorFrame` (device-resident outputs + base passthrough)."""
         if not self._sources:
             return self._base
-        if executor is None and mesh is None and self._forced is not None:
+        if (
+            executor is None and mesh is None and devices is None
+            and self._forced is not None
+        ):
             return self._forced
         from .runtime.executor import default_executor
         from .runtime.retry import maybe_check_numerics
@@ -549,9 +587,10 @@ class LazyFrame:
 
         ex = executor or self._executor or default_executor()
         # the memo write-guard below tests the PARAMETERS (an explicit
-        # executor/mesh override is a one-off), so the plan's own mesh
-        # resolves into a separate name
+        # executor/mesh/devices override is a one-off), so the plan's
+        # own mesh resolves into a separate name
         use_mesh = mesh if mesh is not None else self._mesh
+        use_devices = devices if devices is not None else self._devices
         frame = self._base
         out_names = sorted(self._sources)
         fetch_edges = [self._sources[c] for c in out_names]
@@ -583,8 +622,12 @@ class LazyFrame:
                         for ph, col in self._feed_map.items()
                     },
                 )
+                from .runtime import scheduler as _rs
                 from .utils import telemetry as _tele
 
+                sched = _rs.schedule_for(
+                    frame, devices=use_devices, executor=ex
+                )
                 fp = self._graph.fingerprint()
                 acc: Dict[str, List] = {n: [] for n in out_names}
                 for bi in range(frame.num_blocks):
@@ -598,11 +641,13 @@ class LazyFrame:
                     bucket = hi - lo
                     if bucketed:
                         feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
+                    call = sched.bind(bi, fn) if sched is not None else fn
                     with _tele.dispatch_span(
                         "lazy.force.block", program=fp, block=bi,
                         rows=hi - lo, bucket=bucket if bucketed else None,
+                        device=sched.label(bi) if sched is not None else None,
                     ):
-                        outs = fn(*feeds)
+                        outs = call(*feeds)
                     outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
                     maybe_check_numerics(
                         out_names, outs, f"lazy fused block {bi}"
@@ -616,11 +661,14 @@ class LazyFrame:
                             )
                         acc[n].append(o)
                 vinfo = self.info
+                anchor = (
+                    sched.anchor_device() if sched is not None else None
+                )
                 out_cols = []
                 for n in out_names:
                     parts = acc[n]
                     if parts:
-                        data = _api._concat_parts(parts)
+                        data = _api._concat_parts(parts, anchor)
                     else:  # all blocks empty: zero-row column from analysis
                         ci = vinfo[n]
                         data = np.zeros(
@@ -639,7 +687,7 @@ class LazyFrame:
                     if c not in shadow
                 ]
                 out = TensorFrame(cols, frame.offsets)
-        if executor is None and mesh is None:
+        if executor is None and mesh is None and devices is None:
             self._forced = out
         return out
 
@@ -662,22 +710,25 @@ class LazyFrame:
         return self.force().column(name)
 
     # -- non-terminal frame ops -----------------------------------------
-    def to_device(self, mesh=None) -> "LazyFrame":
+    def to_device(self, mesh=None, device=None) -> "LazyFrame":
         return LazyFrame(
-            self._base.to_device(mesh), self._graph, self._sources,
-            self._feed_map, self._stages, self._executor, self._mesh,
+            self._base.to_device(mesh, device=device), self._graph,
+            self._sources, self._feed_map, self._stages, self._executor,
+            self._mesh, self._devices,
         )
 
     def repartition(self, num_blocks: int) -> "LazyFrame":
         return LazyFrame(
             self._base.repartition(num_blocks), self._graph, self._sources,
             self._feed_map, self._stages, self._executor, self._mesh,
+            self._devices,
         )
 
     def analyze(self) -> "LazyFrame":
         return LazyFrame(
             self._base.analyze(), self._graph, self._sources,
             self._feed_map, self._stages, self._executor, self._mesh,
+            self._devices,
         )
 
     def print_schema(self) -> None:

@@ -58,6 +58,12 @@ class Executor:
     # policy (`shape_policy`) on this executor: jit re-specializes per
     # concrete shape, so quantizing block shapes bounds its compiles.
     supports_bucketing = True
+    # The multi-device block scheduler (`runtime.scheduler`) may spread
+    # this executor's per-block dispatches across jax.local_devices():
+    # programs run wherever their committed inputs live, so placement is
+    # a device_put away. The native executor sets this False — it owns
+    # its own PJRT host and must never see in-process device_put arrays.
+    supports_scheduling = True
 
     def __init__(self):
         self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
@@ -67,6 +73,13 @@ class Executor:
         # a recompile storm shows up as misses growing with call count
         self.cache_hits = 0
         self.cache_misses = 0
+        # per-device scheduler ledgers (device label -> count), kept by
+        # `runtime.scheduler` under self._lock and surfaced through
+        # executor_stats: where dispatches landed and which devices paid
+        # jit specializations (compiles are best-effort under
+        # concurrent verbs, same caveat as _instrument)
+        self.device_dispatches: Dict[str, int] = {}
+        self.device_compiles: Dict[str, int] = {}
         # cached-program keys already flagged by the recompile-storm
         # warning (one warning per program, ever)
         self._storm_warned: set = set()

@@ -81,6 +81,12 @@ class NativeExecutor:
     # executable per input shape signature, so quantizing block shapes
     # bounds native compiles exactly as it bounds jit specializations.
     supports_bucketing = True
+    # Never block-scheduled: execution flows through the host's own
+    # buffer protocol, and an in-process jax.device_put beside a host
+    # that may own the same device is the documented double-client
+    # hazard. The block scheduler skips this executor (and an explicit
+    # devices= on a verb raises).
+    supports_scheduling = False
 
     def _bind_host(self, host, jax_fallback: bool = False) -> None:
         """All non-host state in one place (also the seam tests use to

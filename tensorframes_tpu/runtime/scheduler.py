@@ -1,0 +1,329 @@
+"""Multi-device block scheduler: data-parallel dispatch of blocks.
+
+The reference ran one TF session per Spark partition on whatever
+executor the cluster handed it; the port's non-mesh verbs inherited a
+single-device analogue — every per-block jit dispatch landed on the
+default JAX device, so on a multi-chip host every device but one sat
+idle unless the user hand-built a mesh. Blocks are an embarrassingly
+parallel unit of work; this module spreads them.
+
+Placement is size-aware largest-first (LPT greedy): blocks sorted by
+row count descending are assigned one at a time to the least-loaded
+device, which bounds the makespan at 4/3 OPT and — crucially — is
+DETERMINISTIC, so a re-run dispatches every block to the same device
+and compiles nothing new. The dispatch loop itself stays in block
+order: assignment decides *where*, never *when*, so partial lists keep
+their block order and ordering-sensitive tests/semantics are untouched.
+
+Execution placement rides jax's committed-input semantics: each block's
+feeds are `jax.device_put` onto the assigned device (async; H2D copies
+to different devices overlap) and the jitted program runs where its
+inputs live. The executor cache entry is shared across devices — the
+per-device program specialization happens in jit's own cache, which
+keys on the committed device exactly as it keys on shape (the same
+mechanism `shape_policy` leans on for bucketing), so per-device compile
+counts are visible through `jit_shape_compiles` and bounded by
+``ndev x`` the single-device count (``ndev x`` ladder rungs under
+bucketing).
+
+Reduce verbs fold per-device partials locally and run ONE final
+cross-device combine on the anchor device (associative direct monoid
+graphs only — see `api._combine_partials_scheduled`); everything stays
+an async device op, so the number of host syncs does not grow.
+
+Scheduling turns on via ``config.block_scheduler`` /
+``TFS_BLOCK_SCHEDULER`` ("auto": on when >1 local device) or an
+explicit ``devices=`` override on any non-mesh verb; ``mesh=`` always
+takes precedence (a mesh owns its own placement). The native executor
+(`NativeExecutor.supports_scheduling = False`) is never scheduled — it
+owns its own PJRT host and `device_put` would initialize the
+in-process JAX backend next to it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "BlockSchedule",
+    "device_label",
+    "plan",
+    "resolve",
+    "schedule_for",
+    "schedule_weights",
+]
+
+_MODES = ("auto", "on", "off")
+
+
+def device_label(dev) -> str:
+    """The telemetry label for a device: ``platform:id`` (what dispatch
+    spans, per-device executor stats and the queue-depth gauge key on)."""
+    return f"{getattr(dev, 'platform', 'dev')}:{getattr(dev, 'id', '?')}"
+
+
+def plan(weights: Sequence[int], ndev: int) -> List[Optional[int]]:
+    """Size-aware largest-first placement: item indices sorted by weight
+    descending (ties: lower index first) are greedily assigned to the
+    least-loaded device slot (ties: lowest slot). Returns one slot per
+    item; zero-weight items map to ``None`` (empty blocks are never
+    dispatched, so they must not skew the load ledger)."""
+    if ndev < 1:
+        raise ValueError(f"plan needs >= 1 device, got {ndev}")
+    order = sorted(range(len(weights)), key=lambda i: (-int(weights[i]), i))
+    load = [0] * ndev
+    out: List[Optional[int]] = [None] * len(weights)
+    for i in order:
+        w = int(weights[i])
+        if w <= 0:
+            continue
+        slot = min(range(ndev), key=lambda s: (load[s], s))
+        load[slot] += w
+        out[i] = slot
+    return out
+
+
+def _local_devices() -> List:
+    import jax
+
+    return list(jax.local_devices())
+
+
+def _normalize_devices(devices) -> Tuple:
+    """Explicit ``devices=``: accept jax Device objects or local-device
+    indices; reject empty (an empty override means the caller's intent
+    is unclear — pass None for auto or set block_scheduler='off')."""
+    devs = list(devices)
+    if not devs:
+        raise ValueError(
+            "devices=[] is ambiguous; pass None (config decides) or "
+            "disable with config.block_scheduler='off'"
+        )
+    local = None
+    out = []
+    for d in devs:
+        if isinstance(d, (int, np.integer)):
+            if local is None:
+                local = _local_devices()
+            if not 0 <= int(d) < len(local):
+                raise ValueError(
+                    f"devices: index {int(d)} out of range for "
+                    f"{len(local)} local device(s)"
+                )
+            out.append(local[int(d)])
+        else:
+            out.append(d)
+    return tuple(out)
+
+
+def resolve(
+    devices=None, executor=None, mesh=None
+) -> Optional[Tuple]:
+    """The device set a verb call should schedule blocks over, or None
+    when scheduling is off for this dispatch.
+
+    Precedence: ``mesh=`` wins outright (the mesh path owns placement);
+    an executor that does not opt in (`supports_scheduling`) is never
+    scheduled — with an explicit ``devices=`` that is a loud error, not
+    a silent drop; an explicit ``devices=`` list wins over the config;
+    otherwise ``config.block_scheduler``: "off" disables, "on" schedules
+    onto all local devices (even one — useful to force the scheduled
+    code path), "auto" (default) schedules only when >1 local device
+    exists."""
+    if mesh is not None:
+        if devices is not None:
+            raise ValueError(
+                "devices= and mesh= are mutually exclusive; the mesh "
+                "owns block placement"
+            )
+        return None
+    supported = executor is None or getattr(
+        executor, "supports_scheduling", False
+    )
+    if devices is not None:
+        if not supported:
+            raise ValueError(
+                "devices= needs an executor that supports block "
+                f"scheduling; {type(executor).__name__} does not (the "
+                "native host owns its own device)"
+            )
+        return _normalize_devices(devices)
+    if not supported:
+        return None
+    from .. import config as _config
+
+    mode = _config.get().block_scheduler
+    if mode not in _MODES:
+        # fail loud: a typo'd mode silently meaning "off" would defeat
+        # the knob (same discipline as config.native_executor)
+        raise ValueError(
+            f"config.block_scheduler={mode!r} is not one of "
+            "'auto' | 'on' | 'off'"
+        )
+    if mode == "off":
+        return None
+    devs = _local_devices()
+    if mode == "auto" and len(devs) < 2:
+        return None
+    return tuple(devs)
+
+
+class BlockSchedule:
+    """One verb call's placement: device set + per-item slot assignment.
+
+    ``bind(i, fn)`` returns the dispatch callable for item ``i``: it
+    `device_put`s the feeds onto the assigned device, invokes ``fn``
+    (committed inputs place the execution), and keeps the per-device
+    dispatch/compile ledgers on the executor plus the per-device
+    queue-depth gauge. ``put(i, feeds)`` is the feeds-only half for
+    callers that invoke the program themselves."""
+
+    __slots__ = (
+        "devices", "labels", "assignment", "executor", "_remaining",
+        "_lock",
+    )
+
+    def __init__(self, devices: Tuple, assignment: List[Optional[int]],
+                 executor=None):
+        self.devices = tuple(devices)
+        self.labels = tuple(device_label(d) for d in self.devices)
+        self.assignment = list(assignment)
+        self.executor = executor
+        self._remaining = [0] * len(self.devices)
+        for s in self.assignment:
+            if s is not None:
+                self._remaining[s] += 1
+        self._lock = threading.Lock()
+
+    @property
+    def ndev(self) -> int:
+        return len(self.devices)
+
+    def slot(self, i: int) -> Optional[int]:
+        return self.assignment[i]
+
+    def device(self, i: int):
+        s = self.assignment[i]
+        return None if s is None else self.devices[s]
+
+    def label(self, i: int) -> Optional[str]:
+        s = self.assignment[i]
+        return None if s is None else self.labels[s]
+
+    def anchor_device(self):
+        """Where cross-device results converge (final combines, gathered
+        partials): slot 0, deterministically."""
+        return self.devices[0]
+
+    # -- dispatch ------------------------------------------------------
+    def put(self, i: int, feeds: Sequence) -> List:
+        """`device_put` the feeds onto item ``i``'s device (async) and
+        account the dispatch (per-device ledger + queue-depth gauge)."""
+        import jax
+
+        s = self.assignment[i]
+        if s is None:
+            return list(feeds)
+        dev = self.devices[s]
+        out = [jax.device_put(f, dev) for f in feeds]
+        self._note_dispatch(s)
+        return out
+
+    def bind(self, i: int, fn, valid=None):
+        """The dispatch callable for item ``i``: feeds -> outputs on the
+        assigned device. ``valid`` prefixes the call with the traced
+        true-row-count scalar of a masked bucketed reduce program
+        (`shape_policy.build_masked_reduce`'s calling convention).
+        Detects per-device jit compiles by watching the program's jit
+        cache across the call (best-effort under concurrent verbs —
+        same caveat as `Executor._instrument`)."""
+        s = self.assignment[i]
+
+        def call(*feeds):
+            import jax
+
+            if s is None:
+                return fn(*feeds) if valid is None else fn(
+                    np.int32(valid), *feeds
+                )
+            dev = self.devices[s]
+            put = [jax.device_put(f, dev) for f in feeds]
+            sizer = getattr(fn, "_cache_size", None)
+            n0 = None
+            if callable(sizer):
+                try:
+                    n0 = sizer()
+                except Exception:
+                    n0 = None
+            if valid is None:
+                out = fn(*put)
+            else:
+                out = fn(np.int32(valid), *put)
+            if n0 is not None:
+                try:
+                    n1 = sizer()
+                except Exception:
+                    n1 = None
+                if n1 is not None and n1 > n0:
+                    _bump(self.executor, "device_compiles",
+                          self.labels[s], n1 - n0)
+            self._note_dispatch(s)
+            return out
+
+        return call
+
+    def _note_dispatch(self, s: int) -> None:
+        _bump(self.executor, "device_dispatches", self.labels[s], 1)
+        from ..utils import telemetry as _tele
+
+        with self._lock:
+            self._remaining[s] = max(0, self._remaining[s] - 1)
+            depth = self._remaining[s]
+        if _tele.enabled():
+            # host-side dispatch queue: how many planned dispatches for
+            # this device have not been issued yet this verb call
+            _tele.gauge_set(
+                "scheduler_queue_depth", depth, device=self.labels[s]
+            )
+
+
+def _bump(ex, attr: str, label: str, n: int) -> None:
+    """Increment a per-device ledger dict on the executor, under its
+    lock when it has one. Executors without the ledger (stubs, native)
+    are silently skipped — the ledgers are observability, not
+    correctness."""
+    d = getattr(ex, attr, None)
+    if d is None:
+        return
+    lock = getattr(ex, "_lock", None)
+    if lock is not None:
+        with lock:
+            d[label] = d.get(label, 0) + n
+    else:  # pragma: no cover - executors always carry _lock today
+        d[label] = d.get(label, 0) + n
+
+
+def schedule_weights(
+    weights: Sequence[int], devices=None, executor=None, mesh=None
+) -> Optional[BlockSchedule]:
+    """Resolve the device set and plan ``weights`` over it; None when
+    scheduling is off for this dispatch (the caller then runs the
+    ordinary unscheduled loop)."""
+    devs = resolve(devices=devices, executor=executor, mesh=mesh)
+    if devs is None:
+        return None
+    return BlockSchedule(devs, plan(weights, len(devs)), executor=executor)
+
+
+def schedule_for(
+    frame, devices=None, executor=None, mesh=None
+) -> Optional[BlockSchedule]:
+    """`schedule_weights` over a frame's block sizes — the per-block
+    verbs' entry point (one dispatch per non-empty block, weighted by
+    row count)."""
+    return schedule_weights(
+        frame.block_sizes(), devices=devices, executor=executor, mesh=mesh
+    )

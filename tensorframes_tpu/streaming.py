@@ -143,6 +143,7 @@ def reduce_blocks_stream(
     executor: Optional[Executor] = None,
     mesh=None,
     fold_every="auto",
+    devices=None,
 ):
     """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
     hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
@@ -187,16 +188,43 @@ def reduce_blocks_stream(
 
     def _combine(parts: List[Dict]) -> Dict:
         # device partials stack on device (one dispatch, no host
-        # round-trip between fold generations); host partials stay host
+        # round-trip between fold generations); host partials stay host.
+        # Rotated-device partials converge on the schedule's anchor so
+        # the stacked frame's columns share one committed device.
+        anchor = sched_devs[0] if sched_devs else None
         stacked = TensorFrame.from_dict(
-            {b: _api._stack_parts([p[b] for p in parts]) for b in parts[0]}
+            {
+                b: _api._stack_parts([p[b] for p in parts], anchor)
+                for b in parts[0]
+            }
         )
         r = _api.reduce_blocks(
-            graph, stacked, None, fetch_names=fetch_list, executor=executor
+            graph, stacked, None, fetch_names=fetch_list, executor=executor,
+            # the combine honors the stream's device set (a pinned
+            # stream keeps its combine on the pinned device; rotation
+            # anchors it on sched_devs[0] where the stack landed)
+            devices=list(sched_devs) if sched_devs else None,
         )
         return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
 
     transfer_warned = [False]
+    # Block-scheduled streams round-robin chunks over the device set:
+    # the prefetch transfer stage targets the NEXT chunk's assigned
+    # device, so each device's H2D copy double-buffers under the
+    # previous chunk's compute on a DIFFERENT device, and the per-chunk
+    # reduce below pins its dispatch to the same device. Both sides
+    # derive the assignment from the same chunk ordinal (the stage
+    # processes items in stream order on one thread), so they can never
+    # disagree.
+    stage_idx = [0]
+    consume_idx = [0]
+
+    def _chunk_device(counter):
+        if not sched_devs:
+            return None
+        dev = sched_devs[counter[0] % len(sched_devs)]
+        counter[0] += 1
+        return dev
 
     def _to_device(f):
         # the transfer stage of the prefetch pipeline: issue the H2D
@@ -204,14 +232,16 @@ def reduce_blocks_stream(
         # local single-device path — the mesh path owns its own
         # sharded placement — and only for real frames (tests feed
         # plain dicts through here). Already-device columns pass
-        # through untouched (to_device skips them). LazyFrame chunks
+        # through untouched (to_device skips them; with a scheduled
+        # target device they commit/move there). LazyFrame chunks
         # stage their BASE frame (the pending plan rides along and
         # fuses with the reduce at dispatch below).
+        dev = _chunk_device(stage_idx)  # every item advances the ordinal
         from .lazy import LazyFrame
 
         if isinstance(f, (LazyFrame, TensorFrame)):
             try:
-                return f.to_device()
+                return f.to_device(device=dev)
             except Exception as e:
                 # fall back to host arrays (the reduce dispatch will
                 # transfer implicitly) — but say so ONCE: a silently
@@ -231,20 +261,32 @@ def reduce_blocks_stream(
         return f
 
     from .runtime.executor import default_executor
+    from .runtime import scheduler as _rs
 
     # No transfer stage for the mesh path (it owns its sharded
     # placement) or a native-host executor (`.host`): device_put would
     # initialize the in-process JAX backend next to a host that may own
     # the same device.
     ex = executor if executor is not None else default_executor()
-    stage = (
-        _to_device
-        if mesh is None and getattr(ex, "host", None) is None
-        else None
+    local = mesh is None and getattr(ex, "host", None) is None
+    if devices is not None and not local:
+        raise ValueError(
+            "reduce_blocks_stream: devices= requires the local in-process "
+            "path (no mesh=, no native-host executor)"
+        )
+    sched_devs = (
+        _rs.resolve(devices=devices, executor=ex) if local else None
     )
+    if sched_devs is not None and devices is None and len(sched_devs) < 2:
+        # auto-resolved to one device: plain prefetch, nothing to
+        # rotate. An EXPLICIT one-device list stays: rotation over one
+        # device IS the documented pin (every chunk targets it).
+        sched_devs = None
+    stage = _to_device if local else None
 
     partials: List[Dict] = []
     for f in _prefetch_iter(frames, stage=stage):
+        chunk_dev = _chunk_device(consume_idx)
         nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
         if nrows == 0:
             # Empty chunk (empty file partition / fully filtered shard):
@@ -280,6 +322,12 @@ def reduce_blocks_stream(
             r = _api.reduce_blocks(
                 graph, f, feed_dict, fetch_names=fetch_list,
                 executor=executor, mesh=mesh,
+                # pin the chunk's dispatch to the device its prefetch
+                # transfer targeted: compute lands where the data
+                # already is, and consecutive chunks run on different
+                # devices (compute/compute overlap, not just
+                # transfer/compute)
+                devices=[chunk_dev] if chunk_dev is not None else None,
             )
         partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
         if fold_every is not None and len(partials) >= fold_every:

@@ -107,6 +107,19 @@ def executor_stats(executor=None) -> Dict[str, int]:
     else:
         out["jit_shape_compiles"] = 0
         out["jit_shape_compiles_estimated"] = True
+    # block-scheduler ledgers (`runtime.scheduler`): where dispatches
+    # landed and which devices paid jit specializations. Present for
+    # executors that carry them (the in-process Executor); absent for
+    # the native host and bare stubs, which are never scheduled.
+    for key in ("device_dispatches", "device_compiles"):
+        ledger = getattr(ex, key, None)
+        if ledger is not None:
+            lock = getattr(ex, "_lock", None)
+            if lock is not None:
+                with lock:
+                    out[key] = dict(sorted(ledger.items()))
+            else:
+                out[key] = dict(sorted(ledger.items()))
     return out
 
 

@@ -592,6 +592,7 @@ def span_aggregates(span_list: Optional[List[Span]] = None) -> Dict:
             "window": 0.0, "covered": 0.0, "coverage": 0.0, "roots": 0,
             "spans": 0, "dropped": spans_dropped(),
             "by_verb": {}, "by_kind": {}, "by_program": {},
+            "by_device": {},
         }
     window0 = min(s.t0 for s in ss)
     window1 = max(s.t1 for s in ss)
@@ -601,6 +602,8 @@ def span_aggregates(span_list: Optional[List[Span]] = None) -> Dict:
     by_verb: Dict[str, Dict[str, float]] = {}
     by_kind: Dict[str, Dict[str, float]] = {}
     by_program: Dict[str, Dict[str, float]] = {}
+    dev_intervals: Dict[str, List[Tuple[float, float]]] = {}
+    dev_counts: Dict[str, int] = {}
     for s in ss:
         k = by_kind.setdefault(s.kind, {"seconds": 0.0, "count": 0})
         k["seconds"] += s.seconds
@@ -631,6 +634,23 @@ def span_aggregates(span_list: Optional[List[Span]] = None) -> Dict:
             elif s.kind == "host_sync":
                 p["host_sync_s"] += s.seconds
                 p["host_syncs"] += 1
+        if s.kind == "dispatch":
+            dev = s.attrs.get("device")
+            if dev:
+                # per-device busy-span ledger (block-scheduler labels):
+                # dispatch spans measure async ISSUE windows, so the
+                # union is "this device had work being dispatched to
+                # it" time, not device occupancy — still the honest
+                # utilization skew signal across devices
+                dev_intervals.setdefault(str(dev), []).append((s.t0, s.t1))
+                dev_counts[str(dev)] = dev_counts.get(str(dev), 0) + 1
+    by_device = {
+        d: {
+            "busy_s": _union_seconds(iv),
+            "dispatches": dev_counts[d],
+        }
+        for d, iv in dev_intervals.items()
+    }
     return {
         "window": window,
         "covered": covered,
@@ -641,6 +661,7 @@ def span_aggregates(span_list: Optional[List[Span]] = None) -> Dict:
         "by_verb": by_verb,
         "by_kind": by_kind,
         "by_program": by_program,
+        "by_device": by_device,
     }
 
 
@@ -803,6 +824,19 @@ def diagnostics(executor=None) -> str:
         ):
             lines.append(
                 f"  {kind:<10} {k['seconds']:.4f}s ({k['count']} span(s))"
+            )
+    if agg.get("by_device"):
+        lines.append("")
+        lines.append(
+            "devices (block-scheduler dispatch labels; busy = union of "
+            "dispatch-issue spans, not device occupancy):"
+        )
+        window = max(agg["window"], 1e-12)
+        for dev, d in sorted(agg["by_device"].items()):
+            lines.append(
+                f"  {dev:<10} dispatches={d['dispatches']:<5} "
+                f"busy={d['busy_s']:.4f}s "
+                f"({min(1.0, d['busy_s'] / window) * 100:.1f}% of window)"
             )
     if agg["by_program"]:
         lines.append("")

@@ -81,9 +81,13 @@ class TestBucketedMap:
         sizes = [3, 9, 17, 31, 64, 101, 7, 55]  # 8 distinct sizes
         df = _uneven(sizes)
         ex = Executor()
-        out = tfs.map_blocks(
-            (tfs.block(df, "x") * 2.0 + 1.0).named("y"), df, executor=ex
-        )
+        # single-device compile economics: the block scheduler would
+        # spread blocks over devices and jit once per (device, rung) —
+        # the scheduler suite asserts that scaled bound; here it is off
+        with tfs.config.override(block_scheduler="off"):
+            out = tfs.map_blocks(
+                (tfs.block(df, "x") * 2.0 + 1.0).named("y"), df, executor=ex
+            )
         np.testing.assert_array_equal(
             np.asarray(out["y"].values), df["x"].values * 2.0 + 1.0
         )
@@ -187,7 +191,9 @@ class TestBucketedReduce:
         sizes = list(range(1, 65))  # 64 distinct block sizes
         df = _uneven(sizes)
         ex = Executor()
-        tfs.reduce_blocks(_reduce(df, "sum"), df, executor=ex)
+        # single-device bound (scheduler-off; see TestBucketedMap note)
+        with tfs.config.override(block_scheduler="off"):
+            tfs.reduce_blocks(_reduce(df, "sum"), df, executor=ex)
         rungs = len(set(b for b in df.bucketed_block_sizes() if b))
         # the per-block program compiles one shape per rung; the combine
         # adds one more program/shape
@@ -258,7 +264,11 @@ class TestStreaming:
             for n in sizes
         ]
         ex = Executor()
-        r = tfs.reduce_blocks_stream(self._fetch(), iter(chunks), executor=ex)
+        # single-device bound (scheduler-off; see TestBucketedMap note)
+        with tfs.config.override(block_scheduler="off"):
+            r = tfs.reduce_blocks_stream(
+                self._fetch(), iter(chunks), executor=ex
+            )
         with tfs.config.override(shape_bucketing=False):
             r0 = tfs.reduce_blocks_stream(
                 self._fetch(), iter(chunks), executor=Executor()

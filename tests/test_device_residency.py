@@ -230,7 +230,7 @@ class TestExecutorCacheCounters:
         s = executor_stats()
         assert set(s) == {
             "compile_count", "cache_hits", "cache_misses", "cache_entries",
-            "jit_shape_compiles",
+            "jit_shape_compiles", "device_dispatches", "device_compiles",
         }
 
 

@@ -1,0 +1,537 @@
+"""Multi-device block scheduler (ISSUE 5): data-parallel block dispatch.
+
+The contract under test: with >1 local device (the conftest forces an
+8-device virtual CPU mesh) every non-mesh verb spreads its per-block
+dispatches across `jax.local_devices()` — size-aware largest-first
+placement, deterministic across runs — while results stay bit-identical
+to single-device execution for maps/min/max (float sum/mean within the
+documented reassociation tolerance), host-sync counts do not grow, and
+the placement is observable through dispatch-span ``device`` labels and
+the per-device executor ledgers.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import dsl
+from tensorframes_tpu.runtime import scheduler as rs
+from tensorframes_tpu.runtime.executor import Executor
+from tensorframes_tpu.utils import telemetry
+from tensorframes_tpu.utils.inspection import executor_stats
+from tensorframes_tpu.utils.profiling import reset_stats, stats
+
+NDEV = len(jax.local_devices())
+
+multi_device = pytest.mark.skipif(
+    NDEV < 2, reason="needs >1 (virtual) local device"
+)
+
+
+class CountingExecutor(Executor):
+    """Journals every compiled-program invocation (kind order) like the
+    device-residency suite's counting executor; the scheduler ledgers
+    (`device_dispatches`) ride the inherited Executor state."""
+
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def cached(self, kind, graph, fetches, feed_names, make):
+        fn = super().cached(kind, graph, fetches, feed_names, make)
+
+        def wrapped(*args, **kwargs):
+            self.events.append(kind)
+            return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def _frame(sizes, mod=13, dtype=np.float32):
+    n = int(sum(sizes))
+    offsets = list(np.cumsum([0] + list(sizes)))
+    df = tfs.TensorFrame.from_dict({"x": (np.arange(n) % mod).astype(dtype)})
+    return tfs.TensorFrame([df["x"]], offsets)
+
+
+def _reduce(df_like, op, col="x"):
+    ph = tfs.block(df_like, col, tf_name=col + "_input")
+    return {
+        "sum": dsl.reduce_sum,
+        "min": dsl.reduce_min,
+        "max": dsl.reduce_max,
+        "mean": dsl.reduce_mean,
+    }[op](ph, axes=[0]).named(col)
+
+
+def _dispatch_devices(name_prefix):
+    """Device labels of recorded dispatch spans, in span order."""
+    return [
+        s.attrs.get("device")
+        for s in telemetry.spans()
+        if s.kind == "dispatch" and s.name.startswith(name_prefix)
+    ]
+
+
+class TestPlan:
+    def test_largest_first_least_loaded(self):
+        # weights 8,1,7,2: 8->d0, 7->d1, 2->d1 (load 7<8), 1->d1? no:
+        # after 8(d0) 7(d1), next largest 2 -> d1 has 7 < 8 -> d1 (9),
+        # then 1 -> d0 (8<9) -> d0
+        assert rs.plan([8, 1, 7, 2], 2) == [0, 0, 1, 1]
+
+    def test_zero_weight_blocks_unassigned(self):
+        assert rs.plan([4, 0, 4], 2) == [0, None, 1]
+
+    def test_deterministic_under_ties(self):
+        a = rs.plan([5, 5, 5, 5], 4)
+        assert a == rs.plan([5, 5, 5, 5], 4) == [0, 1, 2, 3]
+
+    def test_fewer_blocks_than_devices(self):
+        assert rs.plan([3], 8) == [0]
+
+    def test_balances_load(self):
+        weights = [100, 90, 80, 10, 10, 10, 10, 10]
+        slots = rs.plan(weights, 4)
+        load = [0] * 4
+        for w, s in zip(weights, slots):
+            load[s] += w
+        assert max(load) - min(load) <= 100  # LPT: bounded imbalance
+        assert set(slots) == {0, 1, 2, 3}
+
+    def test_rejects_zero_devices(self):
+        with pytest.raises(ValueError):
+            rs.plan([1], 0)
+
+
+class TestResolve:
+    def test_off_disables(self):
+        with tfs.config.override(block_scheduler="off"):
+            assert rs.resolve() is None
+
+    def test_auto_on_with_multiple_devices(self):
+        with tfs.config.override(block_scheduler="auto"):
+            devs = rs.resolve()
+        if NDEV > 1:
+            assert devs is not None and len(devs) == NDEV
+        else:
+            assert devs is None
+
+    def test_on_schedules_even_one_device(self):
+        with tfs.config.override(block_scheduler="on"):
+            devs = rs.resolve()
+        assert devs is not None and len(devs) == NDEV
+
+    def test_typo_mode_raises(self):
+        with tfs.config.override(block_scheduler="yes"):
+            with pytest.raises(ValueError, match="block_scheduler"):
+                rs.resolve()
+
+    def test_explicit_devices_win_over_off(self):
+        with tfs.config.override(block_scheduler="off"):
+            devs = rs.resolve(devices=[0])
+        assert devs == (jax.local_devices()[0],)
+
+    def test_explicit_empty_devices_rejected(self):
+        with pytest.raises(ValueError, match="devices"):
+            rs.resolve(devices=[])
+
+    def test_mesh_takes_precedence(self):
+        assert rs.resolve(mesh=object()) is None
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            rs.resolve(devices=[0], mesh=object())
+
+    def test_unsupported_executor_never_scheduled(self):
+        class NoSched:
+            supports_scheduling = False
+
+        assert rs.resolve(executor=NoSched()) is None
+        with pytest.raises(ValueError, match="supports block scheduling"):
+            rs.resolve(devices=[0], executor=NoSched())
+
+
+@multi_device
+class TestPlacement:
+    def test_deterministic_placement_counting_executor(self):
+        ex = CountingExecutor()
+        df = _frame([40, 10, 30, 20, 5])
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        first = dict(ex.device_dispatches)
+        assert sum(first.values()) == 5
+        # largest-first over equal devices: every block its own device
+        assert len(first) == 5
+        tfs.map_blocks(z, df, executor=ex)
+        second = dict(ex.device_dispatches)
+        # identical placement on the rerun: every count exactly doubles
+        assert second == {k: 2 * v for k, v in first.items()}
+
+    def test_spans_carry_device_labels_matching_plan(self):
+        telemetry.reset()
+        ex = Executor()
+        df = _frame([40, 10, 30, 20])
+        z = (tfs.block(df, "x") + 1.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        labels = _dispatch_devices("map_blocks.block")
+        expect = rs.plan(df.block_sizes(), NDEV)
+        devs = [rs.device_label(d) for d in jax.local_devices()]
+        assert labels == [devs[s] for s in expect]
+
+    def test_executor_stats_per_device_counts(self):
+        ex = Executor()
+        df = _frame([16, 16, 16])
+        z = (tfs.block(df, "x") * 3.0).named("z")
+        tfs.map_blocks(z, df, executor=ex)
+        s = executor_stats(ex)
+        assert sum(s["device_dispatches"].values()) == 3
+        assert len(s["device_dispatches"]) == 3
+        # each device touched compiled its own jit specialization
+        assert sum(s["device_compiles"].values()) >= 3
+        assert s["jit_shape_compiles"] >= 3
+
+    def test_devices_override_pins(self):
+        ex = Executor()
+        target = jax.local_devices()[1]
+        df = _frame([8, 8, 8])
+        z = (tfs.block(df, "x") - 1.0).named("z")
+        out = tfs.map_blocks(z, df, executor=ex, devices=[target])
+        assert out["z"].values.devices() == {target}
+        assert executor_stats(ex)["device_dispatches"] == {
+            rs.device_label(target): 3
+        }
+
+    def test_diagnostics_renders_device_table(self):
+        telemetry.reset()
+        df = _frame([32, 8, 16, 24])
+        tfs.map_blocks((tfs.block(df, "x") * 1.5).named("z"), df)
+        report = tfs.diagnostics()
+        assert "devices (block-scheduler dispatch labels" in report
+        assert rs.device_label(jax.local_devices()[0]) in report
+
+
+@multi_device
+class TestResults:
+    def test_map_bit_identical_and_no_extra_host_sync(self):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal(999).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=7)
+        z = (tfs.block(df, "x") * 1.7 + 0.3).named("z")
+        with tfs.config.override(block_scheduler="off"):
+            ref = np.asarray(tfs.map_blocks(z, df)["z"].values)
+        reset_stats()
+        out = tfs.map_blocks(z, df)
+        assert stats().get("host_sync", 0) == 0  # concat stays on device
+        np.testing.assert_array_equal(ref, np.asarray(out["z"].values))
+
+    @pytest.mark.parametrize("op", ["min", "max"])
+    def test_reduce_min_max_bit_identical(self, op):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal(500).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=6)
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_blocks(_reduce(df, op), df))
+        out = float(tfs.reduce_blocks(_reduce(df, op), df))
+        assert ref == out
+
+    @pytest.mark.parametrize("op", ["sum", "mean"])
+    def test_reduce_float_sum_mean_within_tolerance(self, op):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal(4096).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=9)
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_blocks(_reduce(df, op), df))
+        out = float(tfs.reduce_blocks(_reduce(df, op), df))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_integer_sum_bit_identical(self):
+        df = _frame([33, 1, 60, 6], dtype=np.int64)
+        with tfs.config.override(block_scheduler="off"):
+            ref = int(tfs.reduce_blocks(_reduce(df, "sum"), df))
+        assert int(tfs.reduce_blocks(_reduce(df, "sum"), df)) == ref
+
+    def test_reduce_rows_fold_order_preserved_bitwise(self):
+        # the left-fold contract admits no regrouping: scheduled runs
+        # must gather partials and fold in block order, so even this
+        # non-associative fp sum is BIT-identical to single-device
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal(257).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=5)
+        x1 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_2")
+        fold = (x1 + x2).named("x")
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_rows(fold, df))
+        assert float(tfs.reduce_rows(fold, df)) == ref
+
+    def test_reduce_rows_single_row_blocks_committed_off_anchor(self):
+        # single-row blocks contribute column SLICES as partials — on a
+        # frame committed to a non-anchor device those live off-slot,
+        # and the scheduled combine must colocate them (regression: the
+        # gather must not trust nominal owner slots)
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        x = (np.arange(72) % 9).astype(np.float32)
+        base = tfs.TensorFrame.from_dict({"x": x})
+        df = tfs.TensorFrame(
+            [base["x"]], [0, 1, 40, 41, 72]
+        ).to_device(device=jax.local_devices()[-1])
+        x1 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_2")
+        fold = (x1 + x2).named("x")
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_rows(fold, df))
+        assert float(tfs.reduce_rows(fold, df)) == ref
+
+    def test_reduce_rows_single_row_blocks_drain_queue_gauge(self):
+        # regression: 1-row blocks take the slice shortcut (no dispatch)
+        # and must carry zero planning weight — otherwise their slot's
+        # scheduler_queue_depth gauge reports a phantom pending dispatch
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        telemetry.reset()
+        x = (np.arange(10) % 7).astype(np.float32)
+        base = tfs.TensorFrame.from_dict({"x": x})
+        df = tfs.TensorFrame([base["x"]], [0, 5, 9, 10])
+        x1 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_1")
+        x2 = dsl.placeholder(ScalarType.float32, Shape(()), name="x_2")
+        assert float(tfs.reduce_rows((x1 + x2).named("x"), df)) == x.sum()
+        _, gauges, _ = telemetry.metrics_snapshot()
+        depths = [
+            v for (name, _), v in gauges.items()
+            if name == "scheduler_queue_depth"
+        ]
+        assert all(v == 0 for v in depths), gauges
+
+    def test_map_rows_dense_stays_device_resident(self):
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal(300).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=4)
+        y = (tfs.row(df, "x") * 2.0).named("y")
+        with tfs.config.override(block_scheduler="off"):
+            ref = np.asarray(tfs.map_rows(y, df)["y"].values)
+        reset_stats()
+        out = tfs.map_rows(y, df)
+        # the satellite fix: per-block parts concatenate ON device —
+        # no hidden per-block D2H sync before a chained verb
+        assert stats().get("host_sync", 0) == 0
+        assert isinstance(out["y"].values, jax.Array)
+        np.testing.assert_array_equal(ref, np.asarray(out["y"].values))
+
+    def test_single_block_frame(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(10.0, dtype=np.float32)}
+        )
+        z = (tfs.block(df, "x") + 5.0).named("z")
+        np.testing.assert_array_equal(
+            np.asarray(tfs.map_blocks(z, df)["z"].values),
+            np.arange(10.0, dtype=np.float32) + 5.0,
+        )
+        assert float(tfs.reduce_blocks(_reduce(df, "sum"), df)) == 45.0
+
+    def test_empty_blocks_skipped(self):
+        df = _frame([0, 5, 0, 7, 0])
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_blocks(_reduce(df, "min"), df))
+        assert float(tfs.reduce_blocks(_reduce(df, "min"), df)) == ref
+        out = tfs.map_blocks((tfs.block(df, "x") * 2.0).named("z"), df)
+        assert out.nrows == 12
+
+    def test_empty_frame_still_raises(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.zeros(0, np.float32)}
+        )
+        with pytest.raises(ValueError, match="empty frame"):
+            tfs.reduce_blocks(_reduce(df, "sum"), df)
+
+    def test_lazy_fused_chain_matches_unscheduled(self):
+        rng = np.random.default_rng(17)
+        x = rng.standard_normal(777).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=5)
+
+        def chain(frame):
+            with tfs.lazy():
+                lf = tfs.map_blocks(
+                    (tfs.block(frame, "x") * 2.0).named("a"), frame
+                )
+            a_in = tfs.block(lf, "a", tf_name="a_input")
+            return float(
+                lf.reduce_blocks(dsl.reduce_sum(a_in, axes=[0]).named("a"))
+            )
+
+        with tfs.config.override(block_scheduler="off"):
+            ref = chain(df)
+        np.testing.assert_allclose(chain(df), ref, rtol=1e-5)
+
+    def test_function_front_end_matches(self):
+        rng = np.random.default_rng(19)
+        x = rng.standard_normal(321).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=6)
+        with tfs.config.override(block_scheduler="off"):
+            ref = np.asarray(
+                tfs.map_blocks(lambda x: {"d": x * 3}, df)["d"].values
+            )
+        out = np.asarray(
+            tfs.map_blocks(lambda x: {"d": x * 3}, df)["d"].values
+        )
+        np.testing.assert_array_equal(ref, out)
+
+    def test_outputs_anchor_coherently_across_calls(self):
+        # regression: two scheduled maps over DIFFERENT partitionings
+        # must not commit their output columns to different devices —
+        # a later dispatch feeding both columns into ONE jit call (the
+        # segment-plan aggregate, or any verb with the scheduler turned
+        # off) would crash on jax's incompatible-devices check
+        x = (np.arange(900) % 11).astype(np.float32)
+        base = tfs.TensorFrame.from_dict({"x": x})
+        ragged = tfs.TensorFrame(
+            [base["x"]], list(np.cumsum([0, 500, 50, 50, 100, 200]))
+        )
+        a = tfs.map_blocks((tfs.block(ragged, "x") * 2.0).named("a"), ragged)
+        b = tfs.map_blocks(
+            (tfs.block(a, "x") + 1.0).named("b"), a.repartition(3)
+        )
+        assert b["a"].values.devices() == b["b"].values.devices()
+        two_col = (
+            tfs.block(b, "a") + tfs.block(b, "b")
+        ).named("c")
+        with tfs.config.override(block_scheduler="off"):
+            out = tfs.map_blocks(two_col, b)  # one jit call, two columns
+        np.testing.assert_allclose(  # a = 2x, b = x+1 (reads passthrough x)
+            np.asarray(out["c"].values), x * 2.0 + (x + 1.0), rtol=1e-6
+        )
+
+    def test_aggregate_exact_plan_matches(self):
+        rng = np.random.default_rng(23)
+        n = 500
+        k = (rng.integers(0, 9, n)).astype(np.int64)
+        v = rng.standard_normal(n).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"k": k, "v": v})
+        g = dsl.reduce_sum(
+            tfs.block(df, "v", tf_name="v_input"), axes=[0]
+        ).named("v")
+        with tfs.config.override(
+            block_scheduler="off", aggregate_segment_fast=False
+        ):
+            ref = tfs.aggregate(g, df.group_by("k"))
+        with tfs.config.override(aggregate_segment_fast=False):
+            out = tfs.aggregate(g, df.group_by("k"))
+        np.testing.assert_array_equal(
+            np.asarray(ref["k"].values), np.asarray(out["k"].values)
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref["v"].values),
+            np.asarray(out["v"].values),
+            rtol=1e-5,
+        )
+
+
+@multi_device
+class TestBucketingInteraction:
+    def test_ragged_repartition_bucketed_and_scheduled(self):
+        from tensorframes_tpu import shape_policy as sp
+
+        sizes = [37, 5, 61, 12, 90, 3, 44, 28]
+        df = _frame(sizes)
+        z = (tfs.block(df, "x") * 2.0 + 1.0).named("z")
+        with tfs.config.override(block_scheduler="off"):
+            ref = np.asarray(tfs.map_blocks(z, df)["z"].values)
+        ex = Executor()
+        out = np.asarray(tfs.map_blocks(z, df, executor=ex)["z"].values)
+        np.testing.assert_array_equal(ref, out)
+        # per-device jit specializations: bounded by (rungs touched per
+        # device) summed over devices <= min(blocks, ndev * ladder)
+        rungs = len(sp.bucket_ladder(max(sizes)))
+        assert ex.jit_shape_compiles() <= min(len(sizes), NDEV * rungs)
+        # rerun compiles nothing new: placement and buckets repeat
+        before = ex.jit_shape_compiles()
+        tfs.map_blocks(z, df, executor=ex)
+        assert ex.jit_shape_compiles() == before
+
+    def test_masked_reduce_scheduled_matches(self):
+        sizes = [37, 5, 61, 12, 90]
+        df = _frame(sizes)  # integer-valued floats: sums order-exact
+        with tfs.config.override(block_scheduler="off"):
+            ref = float(tfs.reduce_blocks(_reduce(df, "sum"), df))
+        ex = Executor()
+        out = float(tfs.reduce_blocks(_reduce(df, "sum"), df, executor=ex))
+        assert out == ref
+        kinds = {k[0] for k in ex.cache_keys()}
+        assert "block-bucketed" in kinds  # masked program still used
+
+
+@multi_device
+class TestStreaming:
+    def test_chunks_land_on_alternating_devices(self):
+        telemetry.reset()
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": np.full(50 + 3 * i, float(i), np.float32)}
+            )
+            for i in range(6)
+        ]
+        g = dsl.reduce_sum(
+            tfs.block(chunks[0], "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        total = float(tfs.reduce_blocks_stream(g, iter(chunks)))
+        expect = sum(float(i) * (50 + 3 * i) for i in range(6))
+        assert total == expect
+        labels = [
+            d for d in _dispatch_devices("reduce_blocks.block") if d
+        ]
+        devs = [rs.device_label(d) for d in jax.local_devices()]
+        # chunk k pinned to device k % ndev (one block per chunk); the
+        # final combine over stacked partials may append one more
+        # scheduled dispatch of its own
+        assert labels[:6] == [devs[i % NDEV] for i in range(6)]
+        assert len(labels) <= 7
+
+    def test_stream_explicit_single_device_pin_honored(self):
+        telemetry.reset()
+        target = jax.local_devices()[3]
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.ones(20, np.float32)})
+            for _ in range(3)
+        ]
+        g = dsl.reduce_sum(
+            tfs.block(chunks[0], "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        total = tfs.reduce_blocks_stream(g, iter(chunks), devices=[target])
+        assert float(total) == 60.0
+        labels = [
+            d for d in _dispatch_devices("reduce_blocks.block") if d
+        ]
+        # regression: a one-device list must PIN every chunk (and the
+        # final combine), not silently fall back to auto scheduling
+        assert labels and set(labels) == {rs.device_label(target)}
+
+    def test_stream_with_empty_chunks_keeps_rotation_and_result(self):
+        chunks = [
+            tfs.TensorFrame.from_dict({"x": np.ones(10, np.float32)}),
+            tfs.TensorFrame.from_dict({"x": np.zeros(0, np.float32)}),
+            tfs.TensorFrame.from_dict({"x": np.ones(20, np.float32)}),
+        ]
+        g = dsl.reduce_sum(
+            tfs.block(chunks[0], "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        assert float(tfs.reduce_blocks_stream(g, iter(chunks))) == 30.0
+
+
+@multi_device
+class TestHostSyncDiscipline:
+    def test_chained_map_reduce_zero_host_syncs(self):
+        rng = np.random.default_rng(29)
+        x = rng.standard_normal(2048).astype(np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=8).to_device()
+        reset_stats()
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        mid = tfs.map_blocks(z, df)
+        g = dsl.reduce_sum(
+            tfs.block(mid, "z", tf_name="z_input"), axes=[0]
+        ).named("z")
+        res = tfs.reduce_blocks(g, mid)
+        assert stats().get("host_sync", 0) == 0  # nothing fetched yet
+        assert isinstance(res, jax.Array)

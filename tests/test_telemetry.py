@@ -398,7 +398,10 @@ class TestExecutorStatsHonesty:
         ex = tfs.Executor()
         df = tfs.TensorFrame.from_dict({"x": np.arange(30.0)})
         z = (tfs.block(df, "x") + 1.0).named("z")
-        with config.override(shape_bucketing=False):
+        # scheduler off: per-device placement would add one jit
+        # specialization per (device, shape) pair and the point here is
+        # the per-SHAPE count of a single-device program
+        with config.override(shape_bucketing=False, block_scheduler="off"):
             for nb in (1, 2, 3):
                 tfs.map_blocks(z, df.repartition(nb), executor=ex)
         per = ex.program_shape_compiles()

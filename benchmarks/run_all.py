@@ -59,6 +59,8 @@ SMOKE_SIZES = {
     "SCHED_BLOCKS": "8",
     "SCHED_ITERS": "2",
     "SCHED_CHAIN": "16",
+    "CHAOS_ROWS": "100000",
+    "CHAOS_BLOCKS": "8",
 }
 
 
@@ -83,10 +85,11 @@ def main():
         "frozen_inception_v3_bench",
         "ragged_map_rows_bench",
         "stream_overlap_bench",
-        # LAST TWO: on a 1-CPU-device host these retarget the process to
-        # a virtual 8-device mesh (clear_backends), which must not leak
-        # into any bench that runs before them
+        # LAST THREE: on a 1-CPU-device host these retarget the process
+        # to a virtual 8-device mesh (clear_backends), which must not
+        # leak into any bench that runs before them
         "scheduler_bench",
+        "chaos_bench",
         "train_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")

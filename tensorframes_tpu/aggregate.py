@@ -359,6 +359,9 @@ def _aggregate_segment(
             _rs._bump(ex, "device_dispatches", dev_label, 1)
     from .utils import telemetry as _tele
 
+    from . import config as _config2
+    from .runtime import faults as _faults
+
     with _tele.span(
         "aggregate.plan.segment", kind="stage", program=graph.fingerprint()
     ):
@@ -366,7 +369,13 @@ def _aggregate_segment(
             "aggregate.segment", program=graph.fingerprint(),
             rows=frame.nrows, groups=num_groups, device=dev_label,
         ):
-            outs = sfn(gid, counts, *feeds)
+            # classified transient retry (one whole-frame dispatch — no
+            # block fan-out to fail over or split)
+            outs = _faults.run_with_retries(
+                sfn, gid, counts, *feeds,
+                attempts=_config2.get().block_retry_attempts,
+                what="aggregate segment dispatch", verb="aggregate",
+            )
     maybe_check_numerics(bases, outs, "aggregate (segment fast path)")
     # device-resident output: the per-group table stays where the
     # segment ops produced it; a chained verb (or host_values) decides
@@ -495,11 +504,22 @@ def _aggregate_chunked(
         feeds = [col_data[n][row_idx] for n in feed_names]
         if sched is not None:
             feeds = sched.put(pi, feeds)
+        from . import config as _config
+        from .runtime import faults as _faults
+
         with _tele.dispatch_span(
             "aggregate.chunk", program=program, rows=n_p * p, size=p,
             device=sched.label(pi) if sched is not None else None,
         ):
-            outs = run(feeds)
+            # classified transient retry; the feeds are already
+            # committed (sched.put above), so the retry re-runs in
+            # place — per-chunk-size programs are few and large, the
+            # useful failover unit here is the whole verb call
+            outs = _faults.run_with_retries(
+                run, feeds,
+                attempts=_config.get().block_retry_attempts,
+                what=f"aggregate chunks of size {p}", verb="aggregate",
+            )
         maybe_check_numerics(bases, outs, f"aggregate chunks of size {p}")
         pending.append((n_p, np.asarray(chunk_slots_by_p[p]), tuple(outs)))
     partials: Dict[str, Optional[np.ndarray]] = {b: None for b in bases}

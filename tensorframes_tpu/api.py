@@ -323,6 +323,68 @@ def _quiet_donation_warning() -> None:
             _donation_warning_filtered = True
 
 
+def _dispatch_reduce_block(
+    span_name, fp, fn, mask_plan, sched, fscope, bi, lo, hi,
+    feeds_for, split_combs, what_verb,
+):
+    """One reduce-block dispatch with classified fault handling — THE
+    shared recipe of the eager reduce and the fused lazy reduce
+    terminal. Transient errors retry with backoff (+ device failover
+    under the scheduler, via ``fscope``); a RESOURCE error (OOM)
+    splits ``[lo, hi)`` in half down the bucket ladder and
+    monoid-combines the half partials (`faults.combine_split_partials`)
+    when ``split_combs`` — the chunk-classifier verdict, in fetch
+    order — proves the graph combinable; unclassifiable graphs
+    re-raise the original error exactly. Returns the partial tuple."""
+    from . import shape_policy as _sp
+    from .runtime import faults as _flt
+    from .utils import telemetry as _tele
+
+    def run(lo_, hi_, depth):
+        feeds = feeds_for(lo_, hi_)
+
+        def _thunk():
+            # per-attempt span: retried/failed-over attempts each
+            # charge the device they actually dispatched to
+            with _tele.dispatch_span(
+                span_name, program=fp, block=bi, rows=hi_ - lo_,
+                masked=mask_plan is not None or None,
+                device=sched.label(bi) if sched is not None else None,
+            ):
+                if mask_plan is not None:
+                    if sched is not None:
+                        pfeeds, _ = _sp.pad_feeds(feeds, hi_ - lo_)
+                        return sched.bind(bi, fn, valid=hi_ - lo_)(*pfeeds)
+                    return _sp.dispatch_masked(fn, feeds, hi_ - lo_)
+                if sched is not None:
+                    return sched.bind(bi, fn)(*feeds)
+                return fn(*feeds)
+
+        try:
+            outs = fscope.dispatch(
+                _thunk,
+                what=f"{what_verb} block {bi} rows [{lo_}:{hi_})",
+                sched=sched, index=bi,
+            )
+        except Exception as e:
+            if (
+                _flt.classify(e) != _flt.RESOURCE
+                or split_combs is None
+                or not _flt.split_allowed(hi_ - lo_, depth)
+            ):
+                raise
+            mid = (lo_ + hi_) // 2
+            _flt.note_split(what_verb)
+            left = run(lo_, mid, depth + 1)
+            right = run(mid, hi_, depth + 1)
+            return _flt.combine_split_partials(
+                split_combs, left, right, mid - lo_, hi_ - mid
+            )
+        return tuple(outs)
+
+    return run(lo, hi, 0)
+
+
 def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
     """One jitted donated combine over all per-block partials — the ONE
     donation/caching discipline both reduce verbs share.
@@ -345,6 +407,7 @@ def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
         return jax.jit(combine)
 
     cfn = ex.cached(kind, graph, fetch_list, feed_names, make)
+    from .runtime import faults as _flt
     from .utils import telemetry as _tele
 
     # rows stays unset: the combine consumes per-block PARTIALS, and a
@@ -353,7 +416,37 @@ def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
     with _tele.dispatch_span(
         kind, program=graph.fingerprint(), partials=len(partials)
     ):
-        return tuple(cfn(tuple(partials)))
+        # Classified transient retry — with a donation caveat: on
+        # donating executors a failure INSIDE the compiled call may
+        # have consumed the partial buffers already, in which case the
+        # retry dies on deleted arrays. That secondary error must not
+        # mask the real one, so the ORIGINAL transient error re-raises
+        # whenever the retry fails differently. (Injected faults raise
+        # before the program runs, so their retries do recover.) No
+        # split handler here — partials are already reduced, there is
+        # no row range to halve — so resource errors surface
+        # immediately.
+        from . import config as _config
+
+        try:
+            return tuple(cfn(tuple(partials)))
+        except Exception as first:
+            attempts = _config.get().block_retry_attempts
+            if _flt.classify(first) != _flt.TRANSIENT or attempts < 1:
+                # attempts=0 means retries are OFF — the config contract
+                # every FaultScope site honors applies here too
+                raise
+            _flt.note_transient_retry()
+            try:
+                return tuple(
+                    _flt.run_with_retries(
+                        cfn, tuple(partials),
+                        attempts=attempts - 1,
+                        what=f"{kind} combine", verb=kind,
+                    )
+                )
+            except Exception as second:
+                raise first from second
 
 
 def _assoc_reduce(graph, fetch_list, summary) -> bool:
@@ -769,22 +862,85 @@ def map_blocks(
     # non-rowwise graphs keep the exact per-shape dispatch.
     from . import shape_policy as _sp
 
-    bucketed = (
+    from . import config as _config
+
+    # the row-local walk feeds bucketing AND OOM split eligibility;
+    # with both knobs off it is dead weight on the hot path — skip it
+    rowwise = (
         not trim
         and not bindings
-        and _sp.enabled(ex)
+        and (_sp.enabled(ex) or _config.get().oom_split_depth > 0)
         and _sp.rowwise_fetches(
             graph,
             fetch_list,
             {p: ph.shape.rank for p, ph in summary.inputs.items()},
         )
     )
+    bucketed = rowwise and _sp.enabled(ex)
 
+    from .runtime import faults as _flt
     from .runtime import scheduler as _rs
     from .utils import telemetry as _tele
 
     sched = _rs.schedule_for(frame, devices=devices, executor=ex)
+    fscope = _flt.scope("map_blocks")
     fp = graph.fingerprint()
+
+    def _dispatch_rows(bi: int, lo_: int, hi_: int, depth: int) -> List:
+        """Dispatch rows ``[lo_, hi_)`` of block ``bi`` with classified
+        fault handling (`runtime.faults`): transient errors retry with
+        backoff (+ device failover under the scheduler); a RESOURCE
+        error (OOM) splits the range in half down the bucket ladder
+        and concatenates the halves — valid exactly for row-local
+        graphs, bounded by ``config.oom_split_depth``; unclassifiable
+        graphs re-raise the original error."""
+        feeds = [
+            bindings[n]
+            if n in bindings
+            else (
+                frame.column(mapping[n]).values
+                if (lo_ == 0 and hi_ == frame.nrows)
+                else frame.column(mapping[n]).values[lo_:hi_]
+            )
+            for n in feed_names
+        ]
+        bucket = hi_ - lo_
+        if bucketed:
+            feeds, bucket = _sp.pad_feeds(feeds, hi_ - lo_)
+
+        def _thunk():
+            # span inside the thunk: each ATTEMPT records its own
+            # dispatch span labeled with the device it actually ran on
+            # (after failover the retry charges the NEW device, and
+            # backoff sleeps stay outside dispatch spans)
+            call = sched.bind(bi, fn) if sched is not None else fn
+            with _tele.dispatch_span(
+                "map_blocks.block", program=fp, block=bi, rows=hi_ - lo_,
+                bucket=bucket if bucketed else None,
+                device=sched.label(bi) if sched is not None else None,
+            ):
+                return call(*feeds)
+
+        try:
+            outs = fscope.dispatch(
+                _thunk,
+                what=f"map_blocks block {bi} rows [{lo_}:{hi_})",
+                sched=sched, index=bi,
+            )
+        except Exception as e:
+            if (
+                _flt.classify(e) != _flt.RESOURCE
+                or not rowwise
+                or not _flt.split_allowed(hi_ - lo_, depth)
+            ):
+                raise
+            mid = (lo_ + hi_) // 2
+            _flt.note_split("map_blocks")
+            left = _dispatch_rows(bi, lo_, mid, depth + 1)
+            right = _dispatch_rows(bi, mid, hi_, depth + 1)
+            return [_concat_parts([a, b]) for a, b in zip(left, right)]
+        return _sp.slice_pad_rows(outs, hi_ - lo_, bucket)
+
     acc: Dict[str, List[np.ndarray]] = {_base(f): [] for f in fetch_list}
     out_sizes: List[int] = []
     for bi in range(frame.num_blocks):
@@ -793,34 +949,7 @@ def map_blocks(
             out_sizes.append(0)
             continue  # empty block: contributes nothing (the reference's
             # empty-partition TODO, `DebugRowOps.scala:386-387`)
-        feeds = [
-            bindings[n]
-            if n in bindings
-            else (
-                frame.column(mapping[n]).values
-                if (lo == 0 and hi == frame.nrows)
-                else frame.column(mapping[n]).values[lo:hi]
-            )
-            for n in feed_names
-        ]
-        bucket = hi - lo
-        if bucketed:
-            feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
-        from . import config as _config
-        from .runtime.retry import run_with_retries
-
-        call = sched.bind(bi, fn) if sched is not None else fn
-        with _tele.dispatch_span(
-            "map_blocks.block", program=fp, block=bi, rows=hi - lo,
-            bucket=bucket if bucketed else None,
-            device=sched.label(bi) if sched is not None else None,
-        ):
-            outs = run_with_retries(
-                call, *feeds,
-                attempts=_config.get().block_retry_attempts,
-                what=f"map_blocks block {bi}",
-            )
-        outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
+        outs = _dispatch_rows(bi, lo, hi, 0)
         maybe_check_numerics(fetch_list, outs, f"map_blocks block {bi}")
         bsize = None
         for f, o in zip(fetch_list, outs):
@@ -991,28 +1120,64 @@ def map_rows(
         # `_concat_parts` below concatenates ON DEVICE (colocating
         # cross-device parts), so a chained verb never pays a hidden
         # per-block D2H sync
+        from .runtime import faults as _flt
         from .runtime import scheduler as _rs
         from .utils import telemetry as _tele
 
         sched = _rs.schedule_for(frame, devices=devices, executor=ex)
+        fscope = _flt.scope("map_rows")
         fp = graph.fingerprint()
+
+        def _dispatch_rows(bi: int, lo_: int, hi_: int, depth: int):
+            # classified faults: transient retries (+ failover under the
+            # scheduler); OOM splits the row range in half — always
+            # valid here, the vmapped per-row program is row-independent
+            # by construction (bound placeholders stay whole)
+            feeds = [
+                bindings[p]
+                if p in bindings
+                else frame.column(mapping[p]).values[lo_:hi_]
+                for p in params
+            ]
+
+            def _thunk():
+                # per-attempt span (see map_blocks._dispatch_rows)
+                call = sched.bind(bi, vfn) if sched is not None else vfn
+                with _tele.dispatch_span(
+                    "map_rows.block", program=fp, block=bi,
+                    rows=hi_ - lo_,
+                    device=sched.label(bi) if sched is not None else None,
+                ):
+                    return call(*feeds)
+
+            try:
+                return _thunk_outs(_thunk, bi, lo_, hi_)
+            except Exception as e:
+                if _flt.classify(e) != _flt.RESOURCE or not _flt.split_allowed(
+                    hi_ - lo_, depth
+                ):
+                    raise
+                mid = (lo_ + hi_) // 2
+                _flt.note_split("map_rows")
+                left = _dispatch_rows(bi, lo_, mid, depth + 1)
+                right = _dispatch_rows(bi, mid, hi_, depth + 1)
+                return [
+                    _concat_parts([a, b]) for a, b in zip(left, right)
+                ]
+
+        def _thunk_outs(thunk, bi, lo_, hi_):
+            return fscope.dispatch(
+                thunk,
+                what=f"map_rows block {bi} rows [{lo_}:{hi_})",
+                sched=sched, index=bi,
+            )
+
         acc: Dict[str, List[np.ndarray]] = {n: [] for n in out_names}
         for bi in range(frame.num_blocks):
             lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
             if lo == hi:
                 continue
-            feeds = [
-                bindings[p]
-                if p in bindings
-                else frame.column(mapping[p]).values[lo:hi]
-                for p in params
-            ]
-            call = sched.bind(bi, vfn) if sched is not None else vfn
-            with _tele.dispatch_span(
-                "map_rows.block", program=fp, block=bi, rows=hi - lo,
-                device=sched.label(bi) if sched is not None else None,
-            ):
-                outs = call(*feeds)
+            outs = _dispatch_rows(bi, lo, hi, 0)
             maybe_check_numerics(out_names, outs, f"map_rows block {bi}")
             for n, o in zip(out_names, outs):
                 acc[n].append(o)
@@ -1166,11 +1331,30 @@ def reduce_blocks(
     # within a bucket). Unclassifiable graphs keep the exact program.
     from . import shape_policy as _sp
 
+    # one classification serves the masked bucketed program AND the
+    # OOM split-retry combine recipe (`faults.combine_split_partials`):
+    # the mask plan already carries the fetch-ordered combiner verdicts,
+    # so the walk runs at most once per call — and not at all when both
+    # bucketing and splitting are off. split_combs=None means a
+    # resource failure re-raises exactly instead of splitting.
+    from . import config as _config
+
     mask_plan = (
         _sp.masked_reduce_plan(graph, fetch_list, summary)
         if _sp.enabled(ex)
         else None
     )
+    if mask_plan is not None:
+        split_combs = list(mask_plan.combiners)
+    elif _config.get().oom_split_depth > 0:
+        classified = _chunk_combiners(graph, fetch_list, summary)
+        split_combs = (
+            [classified[_base(f)] for f in fetch_list]
+            if classified is not None
+            else None
+        )
+    else:
+        split_combs = None
     if mask_plan is not None:
         fn = _sp.masked_callable(ex, graph, fetch_list, feed_names, mask_plan)
     else:
@@ -1187,10 +1371,11 @@ def reduce_blocks(
     # `DataOps.scala:63-81`). maybe_check_numerics is a no-op unless the
     # debug mode is on, in which case it deliberately syncs per block to
     # name the offender.
+    from .runtime import faults as _flt
     from .runtime import scheduler as _rs
-    from .utils import telemetry as _tele
 
     sched = _rs.schedule_for(frame, devices=devices, executor=ex)
+    fscope = _flt.scope("reduce_blocks")
     fp = graph.fingerprint()
     partials: List[Tuple] = []
     owners: List[int] = []  # device slot per partial (scheduled runs)
@@ -1201,22 +1386,15 @@ def reduce_blocks(
             # dispatched: a padded all-pad block would contribute the bare
             # reduction identity (e.g. +inf for Min) and poison the combine
             continue
-        feeds = [frame.column(mapping[n]).values[lo:hi] for n in feed_names]
-        with _tele.dispatch_span(
-            "reduce_blocks.block", program=fp, block=bi, rows=hi - lo,
-            masked=mask_plan is not None or None,
-            device=sched.label(bi) if sched is not None else None,
-        ):
-            if mask_plan is not None:
-                if sched is not None:
-                    pfeeds, _ = _sp.pad_feeds(feeds, hi - lo)
-                    outs = sched.bind(bi, fn, valid=hi - lo)(*pfeeds)
-                else:
-                    outs = _sp.dispatch_masked(fn, feeds, hi - lo)
-            elif sched is not None:
-                outs = sched.bind(bi, fn)(*feeds)
-            else:
-                outs = fn(*feeds)
+        outs = _dispatch_reduce_block(
+            "reduce_blocks.block", fp, fn, mask_plan, sched, fscope,
+            bi, lo, hi,
+            lambda lo_, hi_: [
+                frame.column(mapping[n]).values[lo_:hi_]
+                for n in feed_names
+            ],
+            split_combs, "reduce_blocks",
+        )
         maybe_check_numerics(fetch_list, outs, f"reduce_blocks block {bi}")
         partials.append(tuple(outs))
         owners.append(sched.slot(bi) if sched is not None else 0)
@@ -1387,6 +1565,13 @@ def reduce_rows(
         [0 if s == 1 else s for s in frame.block_sizes()],
         devices=devices, executor=ex,
     )
+    from .runtime import faults as _flt
+
+    # classified transient retry + failover only: the verb's contract is
+    # a LEFT FOLD in row order, so a resource failure cannot split the
+    # block (regrouping would change non-associative results) — OOM
+    # surfaces exactly
+    fscope = _flt.scope("reduce_rows")
     fp = graph.fingerprint()
     partials: List[Tuple] = []
     owners: List[int] = []
@@ -1399,17 +1584,28 @@ def reduce_rows(
             partials.append(tuple(cols[b][0] for b in bases))
             owners.append(0)
         else:
-            with _tele.dispatch_span(
-                "reduce_rows.block", program=fp, block=bi, rows=hi - lo,
-                device=sched.label(bi) if sched is not None else None,
-            ):
-                if sched is not None:
-                    # dict feeds: device_put the values, keep the keys
-                    keys = list(cols)
-                    cols = dict(
-                        zip(keys, sched.put(bi, [cols[k] for k in keys]))
-                    )
-                outs = jfold(cols)
+            def _thunk(cols0=cols, bi=bi):
+                # per-attempt span + per-attempt device_put: a failover
+                # retry puts onto (and its span charges) the re-placed
+                # device
+                with _tele.dispatch_span(
+                    "reduce_rows.block", program=fp, block=bi,
+                    rows=hi - lo,
+                    device=sched.label(bi) if sched is not None else None,
+                ):
+                    c = cols0
+                    if sched is not None:
+                        # dict feeds: device_put the values, keep keys
+                        keys = list(c)
+                        c = dict(
+                            zip(keys, sched.put(bi, [c[k] for k in keys]))
+                        )
+                    return jfold(c)
+
+            outs = fscope.dispatch(
+                _thunk, what=f"reduce_rows block {bi}",
+                sched=sched, index=bi,
+            )
             maybe_check_numerics(bases, outs, f"reduce_rows block {bi}")
             partials.append(tuple(outs))
             owners.append(sched.slot(bi) if sched is not None else 0)
@@ -1592,19 +1788,34 @@ def aggregate(
             [int(s) * int((counts == s).sum()) for s in unique_sizes],
             devices=devices, executor=ex,
         )
+        from .runtime import faults as _flt
+
+        fscope = _flt.scope("aggregate")
         pending: List[Tuple[np.ndarray, Tuple]] = []
         with _tele.span("aggregate.plan.exact", kind="stage", program=fp):
             for si, size in enumerate(unique_sizes):
                 gids = np.nonzero(counts == size)[0]
                 row_idx = starts[gids][:, None] + np.arange(size)[None, :]
                 feeds = [col_data[n][row_idx] for n in feed_names]  # (g, size, *cell)
-                call = sched.bind(si, vraw) if sched is not None else vraw
-                with _tele.dispatch_span(
-                    "aggregate.size", program=fp,
-                    rows=int(size) * len(gids), size=int(size),
-                    device=sched.label(si) if sched is not None else None,
-                ):
-                    outs = call(*feeds)
+
+                def _thunk(si=si, size=size, gids=gids, feeds=feeds):
+                    # per-attempt span (see map_blocks._dispatch_rows)
+                    call = (
+                        sched.bind(si, vraw) if sched is not None else vraw
+                    )
+                    with _tele.dispatch_span(
+                        "aggregate.size", program=fp,
+                        rows=int(size) * len(gids), size=int(size),
+                        device=sched.label(si)
+                        if sched is not None
+                        else None,
+                    ):
+                        return call(*feeds)
+
+                outs = fscope.dispatch(
+                    _thunk, what=f"aggregate groups of size {int(size)}",
+                    sched=sched, index=si,
+                )
                 maybe_check_numerics(
                     bases, outs, f"aggregate groups of size {size}"
                 )

@@ -143,8 +143,60 @@ class Config:
     # O(N) no matter how long the process runs. Applied on
     # `telemetry.reset()` (the ring is rebuilt at the current value).
     telemetry_ring_entries: int = 8192
-    # Spark-style blanket re-execution of failed block runs (pure fns).
-    block_retry_attempts: int = 0
+    # Fault-tolerant dispatch (`runtime.faults`): every block execution
+    # is a pure function of (compiled executable, block arrays) — the
+    # property the reference leaned on for Spark task retry — so a
+    # failed dispatch can be re-run. Errors are CLASSIFIED: only
+    # ``transient`` failures (device lost/preempted, UNAVAILABLE /
+    # INTERNAL / DATA_LOSS runtime statuses) consume retry attempts;
+    # ``deterministic`` errors (dtype/shape bugs, check_numerics
+    # FloatingPointError) surface after exactly one attempt, and
+    # ``resource`` errors (RESOURCE_EXHAUSTED / OOM) trigger block
+    # splitting instead (see oom_split_depth).
+    #
+    # block_retry_attempts: extra attempts per block dispatch for
+    # transient errors (changed semantics vs the pre-classification
+    # blanket retry, which burned attempts on deterministic errors too).
+    block_retry_attempts: int = 3
+    # verb_retry_budget: total transient retries ONE verb call may spend
+    # across all its block dispatches — bounds the worst-case stall of a
+    # verb over many blocks on a flapping device.
+    verb_retry_budget: int = 32
+    # Exponential backoff between transient retries: base * 2^(k-1)
+    # capped at max, times a DETERMINISTIC jitter factor in
+    # [1, 1+retry_jitter] seeded by (retry_seed, dispatch, attempt) —
+    # reruns sleep the same schedule, so fault-injected tests reproduce.
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter: float = 0.25
+    retry_seed: int = 0
+    # OOM graceful degradation: a resource-classified block dispatch
+    # splits the block in half (down the shape-bucketing ladder) and
+    # re-dispatches, up to this many recursive halvings. Row-local maps
+    # concatenate the halves; monoid-classified reduces combine them
+    # (size-weighted for mean); unclassifiable graphs re-raise the
+    # original error exactly. 0 disables splitting.
+    oom_split_depth: int = 3
+    # Device failover (`runtime.scheduler.DeviceHealth`): a transient
+    # dispatch failure opens the device's circuit for this many seconds
+    # (doubling on repeated failures, capped at 8x); its unissued blocks
+    # re-place LPT onto healthy devices, and after the cooldown ONE
+    # half-open probe dispatch re-admits it on success. Explicit
+    # ``devices=`` pins opt out of failover (with a loud warning when a
+    # pinned device is circuit-open).
+    device_cooldown_s: float = 30.0
+    # Device-grant watchdog (`runtime.faults.device_grant`): when > 0,
+    # the scheduler's device acquisition runs under a watchdog thread
+    # and falls back to the CPU backend with a loud one-time warning if
+    # the accelerator backend wedges at device grant for this long
+    # (the stuck-shared-TPU failure mode). 0 disables the watchdog.
+    # Env override TFS_DEVICE_GRANT_TIMEOUT_S seeds the initial value.
+    device_grant_timeout_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            __import__("os").environ.get("TFS_DEVICE_GRANT_TIMEOUT_S", "0")
+            or "0"
+        )
+    )
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).
     check_numerics: bool = False
     # Route verbs through the C++ PJRT host (`runtime.native_executor`)

@@ -445,11 +445,20 @@ class LazyFrame:
         from . import shape_policy as _sp
         from .aggregate import _chunk_combiners
 
-        mask_plan = None
-        if mesh is None and _sp.enabled(ex):
+        # the fused-chain classification serves the masked bucketed
+        # program AND the OOM split-retry recipe: splitting a fused
+        # reduce block is valid exactly when the reduce roots consume a
+        # row-local pending chain (`fused_mask_plan` re-walks the fused
+        # graph to prove it)
+        from . import config as _config
+
+        fused_plan = None
+        if mesh is None and (
+            _sp.enabled(ex) or _config.get().oom_split_depth > 0
+        ):
             classified = _chunk_combiners(rgraph, rfetch, rsummary)
             if classified is not None:
-                mask_plan = _sp.fused_mask_plan(
+                fused_plan = _sp.fused_mask_plan(
                     fused,
                     fused_fetches,
                     [classified[_base(f)] for f in rfetch],
@@ -458,6 +467,10 @@ class LazyFrame:
                         for ph, col in feed_map.items()
                     },
                 )
+        mask_plan = fused_plan if _sp.enabled(ex) else None
+        split_combs = (
+            list(fused_plan.combiners) if fused_plan is not None else None
+        )
         # distinct profiling key: the module verb's decorator already
         # records "reduce_blocks" around this call, and fused-vs-eager
         # dispatch is worth telling apart in stats anyway
@@ -476,12 +489,13 @@ class LazyFrame:
                     )
                 else:
                     fn = ex.callable_for(fused, fused_fetches, feed_names)
+                from .runtime import faults as _flt
                 from .runtime import scheduler as _rs
-                from .utils import telemetry as _tele
 
                 sched = _rs.schedule_for(
                     frame, devices=devices, executor=ex
                 )
+                fscope = _flt.scope("reduce_blocks.fused")
                 fp = fused.fingerprint()
                 partials: List[Tuple] = []
                 owners: List[int] = []
@@ -492,28 +506,15 @@ class LazyFrame:
                         # block would emit the bare reduction identity and
                         # poison the combine — e.g. +inf partials for Min)
                         continue
-                    feeds = [
-                        frame.column(feed_map[n]).values[lo:hi]
-                        for n in feed_names
-                    ]
-                    with _tele.dispatch_span(
-                        "reduce_blocks.fused.block", program=fp,
-                        block=bi, rows=hi - lo,
-                        masked=mask_plan is not None or None,
-                        device=sched.label(bi) if sched is not None else None,
-                    ):
-                        if mask_plan is not None:
-                            if sched is not None:
-                                pfeeds, _ = _sp.pad_feeds(feeds, hi - lo)
-                                outs = sched.bind(
-                                    bi, fn, valid=hi - lo
-                                )(*pfeeds)
-                            else:
-                                outs = _sp.dispatch_masked(fn, feeds, hi - lo)
-                        elif sched is not None:
-                            outs = sched.bind(bi, fn)(*feeds)
-                        else:
-                            outs = fn(*feeds)
+                    outs = _api._dispatch_reduce_block(
+                        "reduce_blocks.fused.block", fp, fn, mask_plan,
+                        sched, fscope, bi, lo, hi,
+                        lambda lo_, hi_: [
+                            frame.column(feed_map[n]).values[lo_:hi_]
+                            for n in feed_names
+                        ],
+                        split_combs, "reduce_blocks.fused",
+                    )
                     maybe_check_numerics(
                         rfetch, outs, f"reduce_blocks (fused) block {bi}"
                     )
@@ -614,7 +615,12 @@ class LazyFrame:
                 # shape per ladder rung instead of per block size
                 from . import shape_policy as _sp
 
-                bucketed = _sp.enabled(ex) and _sp.rowwise_fetches(
+                from . import config as _lconfig
+
+                rowwise = (
+                    _sp.enabled(ex)
+                    or _lconfig.get().oom_split_depth > 0
+                ) and _sp.rowwise_fetches(
                     self._graph,
                     fetch_edges,
                     {
@@ -622,33 +628,77 @@ class LazyFrame:
                         for ph, col in self._feed_map.items()
                     },
                 )
+                bucketed = rowwise and _sp.enabled(ex)
+                from .runtime import faults as _flt
                 from .runtime import scheduler as _rs
                 from .utils import telemetry as _tele
 
                 sched = _rs.schedule_for(
                     frame, devices=use_devices, executor=ex
                 )
+                fscope = _flt.scope("lazy.force")
                 fp = self._graph.fingerprint()
+
+                def _dispatch_rows(bi, lo_, hi_, depth):
+                    # classified faults, same recipe as eager
+                    # map_blocks: transient retries (+ failover under
+                    # the scheduler); OOM splits the row range in half
+                    # for row-local fused chains and concatenates
+                    feeds = [
+                        frame.column(self._feed_map[n]).values[lo_:hi_]
+                        for n in feed_names
+                    ]
+                    bucket = hi_ - lo_
+                    if bucketed:
+                        feeds, bucket = _sp.pad_feeds(feeds, hi_ - lo_)
+
+                    def _thunk():
+                        # per-attempt span (see map_blocks)
+                        call = (
+                            sched.bind(bi, fn) if sched is not None else fn
+                        )
+                        with _tele.dispatch_span(
+                            "lazy.force.block", program=fp, block=bi,
+                            rows=hi_ - lo_,
+                            bucket=bucket if bucketed else None,
+                            device=sched.label(bi)
+                            if sched is not None
+                            else None,
+                        ):
+                            return call(*feeds)
+
+                    try:
+                        outs = fscope.dispatch(
+                            _thunk,
+                            what=(
+                                f"lazy fused block {bi} rows "
+                                f"[{lo_}:{hi_})"
+                            ),
+                            sched=sched, index=bi,
+                        )
+                    except Exception as e:
+                        if (
+                            _flt.classify(e) != _flt.RESOURCE
+                            or not rowwise
+                            or not _flt.split_allowed(hi_ - lo_, depth)
+                        ):
+                            raise
+                        mid = (lo_ + hi_) // 2
+                        _flt.note_split("lazy.force")
+                        left = _dispatch_rows(bi, lo_, mid, depth + 1)
+                        right = _dispatch_rows(bi, mid, hi_, depth + 1)
+                        return [
+                            _api._concat_parts([a, b])
+                            for a, b in zip(left, right)
+                        ]
+                    return _sp.slice_pad_rows(outs, hi_ - lo_, bucket)
+
                 acc: Dict[str, List] = {n: [] for n in out_names}
                 for bi in range(frame.num_blocks):
                     lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
                     if lo == hi:
                         continue
-                    feeds = [
-                        frame.column(self._feed_map[n]).values[lo:hi]
-                        for n in feed_names
-                    ]
-                    bucket = hi - lo
-                    if bucketed:
-                        feeds, bucket = _sp.pad_feeds(feeds, hi - lo)
-                    call = sched.bind(bi, fn) if sched is not None else fn
-                    with _tele.dispatch_span(
-                        "lazy.force.block", program=fp, block=bi,
-                        rows=hi - lo, bucket=bucket if bucketed else None,
-                        device=sched.label(bi) if sched is not None else None,
-                    ):
-                        outs = call(*feeds)
-                    outs = _sp.slice_pad_rows(outs, hi - lo, bucket)
+                    outs = _dispatch_rows(bi, lo, hi, 0)
                     maybe_check_numerics(
                         out_names, outs, f"lazy fused block {bi}"
                     )

@@ -80,6 +80,26 @@ def _mesh_dispatch(name: str, program, rows: int, shards: int):
             yield
 
 
+def _mesh_call(name: str, program, rows: int, shards: int, fn, *args):
+    """`_mesh_dispatch` instrumentation + classified transient retries
+    (`runtime.faults`): one shard_map program is the mesh path's unit
+    of re-execution — a pure function of its feeds, exactly like a
+    block dispatch. Deterministic errors surface after one attempt;
+    there is no device failover inside a mesh (the mesh OWNS its
+    placement — losing a mesh device fails the verb) and no OOM split
+    (halving rows would change the shard layout), so resource errors
+    surface exactly."""
+    from .. import config as _config
+    from ..runtime import faults as _faults
+
+    with _mesh_dispatch(name, program, rows, shards):
+        return _faults.run_with_retries(
+            fn, *args,
+            attempts=_config.get().block_retry_attempts,
+            what=name, verb=name,
+        )
+
+
 @lru_cache(maxsize=64)
 def _mesh_sig(mesh: Mesh) -> str:
     """Cache-key signature of a mesh's concrete device identity. A
@@ -230,10 +250,10 @@ def map_blocks(
                 )
             ),
         )
-        with _mesh_dispatch(
-            "mesh.map_blocks", graph.fingerprint(), s * ndev, ndev
-        ):
-            outs = sharded(*_feeds(main))
+        outs = _mesh_call(
+            "mesh.map_blocks", graph.fingerprint(), s * ndev, ndev,
+            sharded, *_feeds(main),
+        )
         maybe_check_numerics(fetch_list, outs, "map_blocks (mesh shards)")
         shard_out = None
         for f, o in zip(fetch_list, outs):
@@ -253,11 +273,10 @@ def map_blocks(
         block_sizes += [shard_out if trim else s] * ndev
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_list, feed_names)
-        with _mesh_dispatch(
+        outs = _mesh_call(
             "mesh.map_blocks.tail", graph.fingerprint(),
-            tail[cols_used[0]].shape[0], 1,
-        ):
-            outs = tfn(*_feeds(tail))
+            tail[cols_used[0]].shape[0], 1, tfn, *_feeds(tail),
+        )
         maybe_check_numerics(fetch_list, outs, "map_blocks (mesh tail)")
         tail_out = None
         for f, o in zip(fetch_list, outs):
@@ -452,10 +471,10 @@ def map_rows(
                 )
             ),
         )
-        with _mesh_dispatch(
-            "mesh.map_rows", graph.fingerprint(), s * ndev, ndev
-        ):
-            outs = sharded(*_feeds(main))
+        outs = _mesh_call(
+            "mesh.map_rows", graph.fingerprint(), s * ndev, ndev,
+            sharded, *_feeds(main),
+        )
         maybe_check_numerics(fetch_list, outs, "map_rows (mesh shards)")
         for n, o in zip(out_names, outs):
             acc[n].append(o)
@@ -470,11 +489,10 @@ def map_rows(
             params,
             lambda: jax.jit(jax.vmap(fn, in_axes=in_axes)),
         )
-        with _mesh_dispatch(
+        outs = _mesh_call(
             "mesh.map_rows.tail", graph.fingerprint(),
-            tail[cols_used[0]].shape[0], 1,
-        ):
-            outs = vfn(*_feeds(tail))
+            tail[cols_used[0]].shape[0], 1, vfn, *_feeds(tail),
+        )
         maybe_check_numerics(fetch_list, outs, "map_rows (mesh tail)")
         for n, o in zip(out_names, outs):
             acc[n].append(o)
@@ -704,10 +722,10 @@ def fused_map_blocks(
                 )
             ),
         )
-        with _mesh_dispatch(
-            "mesh.lazy.force", graph.fingerprint(), s * ndev, ndev
-        ):
-            outs = sharded(*[main[c] for c in cols_used])
+        outs = _mesh_call(
+            "mesh.lazy.force", graph.fingerprint(), s * ndev, ndev,
+            sharded, *[main[c] for c in cols_used],
+        )
         maybe_check_numerics(out_names, outs, "lazy fused map (mesh shards)")
         for n, o in zip(out_names, outs):
             if o.shape[0] != s * ndev:
@@ -719,11 +737,11 @@ def fused_map_blocks(
             acc[n].append(o[: frame.nrows] if pad_rows else o)
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(graph, fetch_edges, feed_names)
-        with _mesh_dispatch(
+        outs = _mesh_call(
             "mesh.lazy.force.tail", graph.fingerprint(),
             tail[cols_used[0]].shape[0], 1,
-        ):
-            outs = tfn(*[tail[c] for c in cols_used])
+            tfn, *[tail[c] for c in cols_used],
+        )
         maybe_check_numerics(out_names, outs, "lazy fused map (mesh tail)")
         trows = tail[cols_used[0]].shape[0]
         for n, o in zip(out_names, outs):
@@ -799,19 +817,18 @@ def fused_reduce_blocks(
                 )
             ),
         )
-        with _mesh_dispatch(
+        outs = _mesh_call(
             "mesh.reduce_blocks.fused", fused_graph.fingerprint(),
-            s * ndev, ndev,
-        ):
-            outs = sharded(*[main[c] for c in cols_used])
+            s * ndev, ndev, sharded, *[main[c] for c in cols_used],
+        )
         partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         tfn = ex.callable_for(fused_graph, fused_fetches, feed_names)
-        with _mesh_dispatch(
+        outs = _mesh_call(
             "mesh.reduce_blocks.fused.tail", fused_graph.fingerprint(),
             tail[cols_used[0]].shape[0], 1,
-        ):
-            outs = tfn(*[tail[c] for c in cols_used])
+            tfn, *[tail[c] for c in cols_used],
+        )
         partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -942,10 +959,10 @@ def reduce_blocks(
                 feed_names,
                 make_masked_sharded,
             )
-            with _mesh_dispatch(
-                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev
-            ):
-                outs = sharded(shard_valids, *[main[c] for c in cols_used])
+            outs = _mesh_call(
+                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev,
+                sharded, shard_valids, *[main[c] for c in cols_used],
+            )
         else:
             def local_then_gather(*cols):
                 part = fn(*cols)
@@ -971,25 +988,28 @@ def reduce_blocks(
                     )
                 ),
             )
-            with _mesh_dispatch(
-                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev
-            ):
-                outs = sharded(*[main[c] for c in cols_used])
+            outs = _mesh_call(
+                "mesh.reduce_blocks", graph.fingerprint(), s * ndev, ndev,
+                sharded, *[main[c] for c in cols_used],
+            )
         partials.append(tuple(outs))
     if cols_used and tail[cols_used[0]].shape[0] > 0:
         t = [tail[c] for c in cols_used]
-        with _mesh_dispatch(
-            "mesh.reduce_blocks.tail", graph.fingerprint(),
-            t[0].shape[0], 1,
-        ):
-            if mask_plan is not None:
-                mfn = _sp.masked_callable(
-                    ex, graph, fetch_list, feed_names, mask_plan
-                )
-                outs = _sp.dispatch_masked(mfn, t, t[0].shape[0])
-            else:
-                tfn = ex.callable_for(graph, fetch_list, feed_names)
-                outs = tfn(*t)
+        if mask_plan is not None:
+            mfn = _sp.masked_callable(
+                ex, graph, fetch_list, feed_names, mask_plan
+            )
+            outs = _mesh_call(
+                "mesh.reduce_blocks.tail", graph.fingerprint(),
+                t[0].shape[0], 1,
+                _sp.dispatch_masked, mfn, t, t[0].shape[0],
+            )
+        else:
+            tfn = ex.callable_for(graph, fetch_list, feed_names)
+            outs = _mesh_call(
+                "mesh.reduce_blocks.tail", graph.fingerprint(),
+                t[0].shape[0], 1, tfn, *t,
+            )
         partials.append(tuple(outs))
     if not partials:
         raise ValueError("reduce_blocks on an empty frame")
@@ -1086,10 +1106,10 @@ def reduce_rows(
                 )
             ),
         )
-        with _mesh_dispatch(
-            "mesh.reduce_rows", graph.fingerprint(), s * ndev, ndev
-        ):
-            outs = sharded(*[main[c] for c in cols_used])
+        outs = _mesh_call(
+            "mesh.reduce_rows", graph.fingerprint(), s * ndev, ndev,
+            sharded, *[main[c] for c in cols_used],
+        )
         partials.append(tuple(np.asarray(o) for o in outs))
 
     # tail folds + partial combine share ONE cached program (jit
@@ -1226,10 +1246,10 @@ def aggregate(
                 )
             ),
         )
-        with _mesh_dispatch(
-            "mesh.aggregate.segment", graph.fingerprint(), s * ndev, ndev
-        ):
-            outs = sharded(gid[: s * ndev], *main_cols)
+        outs = _mesh_call(
+            "mesh.aggregate.segment", graph.fingerprint(), s * ndev, ndev,
+            sharded, gid[: s * ndev], *main_cols,
+        )
         acc = [np.asarray(o)[:num_keys] for o in outs]
     if tail_cols and tail_cols[0].shape[0] > 0:
         touts = [
@@ -1317,10 +1337,10 @@ def _aggregate_mesh_general(
         # this always shards on any device count, pow2 or not
         lead = feeds[0].shape[0]
         if lead >= ndev and lead % ndev == 0:
-            with _mesh_dispatch(
-                "mesh.aggregate.chunk", graph.fingerprint(), lead, ndev
-            ):
-                return sharded(*feeds)
+            return _mesh_call(
+                "mesh.aggregate.chunk", graph.fingerprint(), lead, ndev,
+                sharded, *feeds,
+            )
         return local(*feeds)
 
     results = _api._aggregate_chunked(

@@ -1,5 +1,6 @@
-"""Runtime layer: compile-cached execution."""
+"""Runtime layer: compile-cached execution + fault-tolerant dispatch."""
 
+from . import faults
 from .executor import Executor, default_executor
 
-__all__ = ["Executor", "default_executor"]
+__all__ = ["Executor", "default_executor", "faults"]

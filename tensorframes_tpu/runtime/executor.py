@@ -22,7 +22,29 @@ import numpy as np
 from ..graph.ir import Graph
 from ..ops.lowering import build_callable
 
-__all__ = ["Executor", "default_executor", "lru_get_or_insert"]
+__all__ = [
+    "Executor",
+    "default_executor",
+    "lru_get_or_insert",
+    "set_fault_injector",
+]
+
+
+# Fault-injection seam (`tensorframes_tpu.testing.faults`): when
+# installed, ``hook(fn, key) -> fn`` wraps every program handed out by
+# `Executor.cached` — the one boundary EVERY dispatch crosses (block
+# maps, vmapped rows, folds, combines, shard_map programs) — so a
+# deterministic chaos harness can fault any dispatch by ordinal /
+# device / program / kind without touching verb code. The wrapper is
+# applied on the way OUT of the cache (never stored), so the compiled
+# program itself is never poisoned. None = production path: one module
+# attribute read per cached() call.
+_fault_injector = None
+
+
+def set_fault_injector(hook) -> None:
+    global _fault_injector
+    _fault_injector = hook
 
 
 def lru_get_or_insert(cache, lock, key, make, limit):
@@ -134,6 +156,8 @@ class Executor:
                 self.cache_misses += 1
             else:
                 self.cache_hits += 1
+        if _fault_injector is not None:
+            fn = _fault_injector(fn, key)
         return fn
 
     def _instrument(self, key: Tuple, fn: Callable) -> Callable:

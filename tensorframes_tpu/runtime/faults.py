@@ -1,0 +1,556 @@
+"""Fault-tolerant dispatch: error taxonomy, classified retries, splits.
+
+The reference outsourced ALL fault tolerance to Spark's task retry +
+lineage recomputation (SURVEY §5: worker kernels are pure functions of
+(broadcast graph, partition rows), so a failed task is simply re-run).
+The port preserved the purity but replaced Spark's supervisor with a
+blanket un-classified retry at a single call site. This module is the
+real supervisor:
+
+- **Taxonomy** (`classify`): every dispatch exception is one of
+
+  - ``transient`` — device lost/preempted, dropped tunnel RPC, the
+    UNAVAILABLE/INTERNAL/DATA_LOSS/ABORTED XlaRuntimeError status
+    families. Re-running the pure block function is expected to
+    succeed; these are retried with exponential backoff and (under the
+    block scheduler) device failover.
+  - ``resource`` — RESOURCE_EXHAUSTED / out-of-memory. Re-running the
+    identical dispatch would fail identically; the dispatch sites
+    instead SPLIT the block in half down the bucket ladder and combine
+    the halves (row-local maps concatenate, classified monoid reduces
+    combine via `combine_split_partials`).
+  - ``deterministic`` — everything else (shape/dtype mismatches,
+    ``FloatingPointError`` from ``check_numerics``, user-graph bugs).
+    The original exception surfaces after EXACTLY ONE attempt; burning
+    a retry budget on a deterministic error only delays the traceback.
+
+- **Classified retry** (`FaultScope` / `run_with_retries`): per-verb
+  retry budget (``config.verb_retry_budget``) on top of the per-block
+  attempt cap (``config.block_retry_attempts``), exponential backoff
+  (``retry_backoff_base_s`` doubling up to ``retry_backoff_max_s``)
+  with DETERMINISTIC seeded jitter — two runs of the same failing
+  workload sleep the same schedule, so chaos tests and the injection
+  harness reproduce bit-for-bit.
+
+- **Fault ledger** (`ledger_snapshot`): process-wide counts by class,
+  plus retries/splits/evictions/fail-fasts — merged into
+  `executor_stats()` and rendered by `tfs.diagnostics()`. The same
+  events feed the always-live telemetry counters
+  ``fault_retries{class=}`` / ``device_evictions`` / ``block_splits``.
+
+- **Device-grant watchdog** (`device_grant`): backend init that hangs
+  acquiring devices (a wedged shared TPU at grant time) times out on a
+  watchdog thread and falls back — by default to the CPU backend —
+  with a loud one-time warning instead of wedging the process forever.
+
+Injected faults from `tensorframes_tpu.testing.faults` carry an
+explicit ``tfs_fault_class`` attribute, which `classify` honors before
+any pattern matching — the harness and the production path share one
+classifier by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Dict, Optional, Sequence
+
+from ..utils.log import get_logger
+
+__all__ = [
+    "TRANSIENT",
+    "RESOURCE",
+    "DETERMINISTIC",
+    "classify",
+    "backoff_delay",
+    "FaultScope",
+    "scope",
+    "run_with_retries",
+    "combine_split_partials",
+    "note_split",
+    "ledger_snapshot",
+    "reset_ledger",
+    "device_grant",
+]
+
+_log = get_logger("faults")
+
+TRANSIENT = "transient"
+RESOURCE = "resource"
+DETERMINISTIC = "deterministic"
+_CLASSES = (TRANSIENT, RESOURCE, DETERMINISTIC)
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+# absl-Status code tokens of the retryable families, matched as
+# STATUS-SHAPED prefixes ("UNAVAILABLE: ..." — always rendered with a
+# colon) so an arbitrary RuntimeError whose prose merely contains the
+# word ("worker thread aborted") is never retried.
+_STATUS_TOKENS = (
+    "UNAVAILABLE",          # backend/tunnel went away
+    "INTERNAL",             # TPU runtime hiccups
+    "DATA_LOSS",
+    "ABORTED",
+    "DEADLINE_EXCEEDED",
+)
+
+# Looser phrases, trusted ONLY on genuine XLA/JAX runtime exception
+# types (and connection errors) — those messages come from the runtime,
+# not from user code, so prose matching is safe there.
+_TRANSIENT_PHRASES = (
+    "DEVICE LOST",
+    "DEVICE IS LOST",
+    "PREEMPT",              # preempted / preemption
+    "SOCKET CLOSED",
+    "CONNECTION RESET",
+    "HEARTBEAT",
+)
+
+_RESOURCE_PATTERNS = (
+    "RESOURCE_EXHAUSTED",
+    "RESOURCE EXHAUSTED",
+    "OUT OF MEMORY",
+    "OOM ",
+    "OOM:",
+    "ALLOCATION FAILURE",
+    "FAILED TO ALLOCATE",
+)
+
+# Exception families whose MESSAGES are trusted for status-token
+# classification: the XLA runtime surfaces everything as
+# XlaRuntimeError/JaxRuntimeError (RuntimeError subclasses), and
+# distributed/IO layers as OSError (ConnectionError, TimeoutError).
+# A ValueError carrying "UNAVAILABLE" in user text stays deterministic.
+_XLA_NAMES = ("XlaRuntimeError", "JaxRuntimeError")
+
+
+def _runtimeish(exc: BaseException) -> bool:
+    if isinstance(exc, (RuntimeError, OSError)):
+        return True
+    return any(t.__name__ in _XLA_NAMES for t in type(exc).__mro__)
+
+
+def _xla_typed(exc: BaseException) -> bool:
+    """A genuine runtime-owned exception (XLA/JAX runtime error class,
+    or a connection failure) — the only types whose message PROSE is
+    trusted, not just status-code prefixes."""
+    if isinstance(exc, ConnectionError):
+        return True
+    return any(t.__name__ in _XLA_NAMES for t in type(exc).__mro__)
+
+
+def classify(exc: BaseException) -> str:
+    """Classify one dispatch exception as ``transient`` | ``resource``
+    | ``deterministic``. Honors an explicit ``tfs_fault_class``
+    attribute first (the injection harness stamps it), then
+    `MemoryError`, then XLA status-code prefixes on runtime-ish
+    exception types (plus runtime-owned phrases on genuine
+    XlaRuntimeError/JaxRuntimeError/connection types). Everything
+    unrecognized is deterministic — the conservative default: an
+    unknown error is surfaced, never silently re-run."""
+    tagged = getattr(exc, "tfs_fault_class", None)
+    if tagged in _CLASSES:
+        return tagged
+    if isinstance(exc, MemoryError):
+        return RESOURCE
+    if _runtimeish(exc):
+        msg = str(exc).upper()
+        if any(p in msg for p in _RESOURCE_PATTERNS):
+            return RESOURCE
+        if any(f"{t}:" in msg for t in _STATUS_TOKENS):
+            return TRANSIENT
+        if _xla_typed(exc) and any(p in msg for p in _TRANSIENT_PHRASES):
+            return TRANSIENT
+    return DETERMINISTIC
+
+
+# ---------------------------------------------------------------------------
+# fault ledger (process-wide; surfaced via executor_stats/diagnostics)
+# ---------------------------------------------------------------------------
+
+_LEDGER_KEYS = (
+    "transient", "resource", "deterministic",  # classified failures seen
+    "retries", "splits", "evictions", "failfast", "grant_timeouts",
+)
+_ledger_lock = threading.Lock()
+_ledger: Dict[str, int] = {k: 0 for k in _LEDGER_KEYS}
+
+
+def _note(key: str, n: int = 1) -> None:
+    with _ledger_lock:
+        _ledger[key] = _ledger.get(key, 0) + n
+
+
+def note_eviction() -> None:
+    """Scheduler hook: one device circuit opened (ledger only; the
+    labeled ``device_evictions`` counter is the scheduler's)."""
+    _note("evictions")
+
+
+def note_transient_retry() -> None:
+    """Ledger + counter for a transient retry performed OUTSIDE
+    `FaultScope.dispatch` (e.g. the combine's donation-aware manual
+    retry in `api._combine_partials`)."""
+    _note(TRANSIENT)
+    _note("retries")
+    from ..utils import telemetry as _tele
+
+    _tele.counter_inc("fault_retries", 1.0, **{"class": TRANSIENT})
+
+
+def note_split(verb: str) -> None:
+    """One OOM block split performed by ``verb`` (ledger + the
+    always-live ``block_splits`` counter; the split IS the resource
+    class's retry, so it counts under ``fault_retries{class=resource}``
+    too)."""
+    _note("splits")
+    _note("retries")
+    from ..utils import telemetry as _tele
+
+    _tele.counter_inc("block_splits", 1.0, verb=verb)
+    _tele.counter_inc("fault_retries", 1.0, **{"class": RESOURCE})
+
+
+def ledger_snapshot() -> Dict[str, int]:
+    """The fault ledger: classified failure counts plus what was done
+    about them (retries / splits / device evictions / fail-fasts /
+    grant timeouts). Merged into ``executor_stats()['faults']``."""
+    with _ledger_lock:
+        return dict(_ledger)
+
+
+def reset_ledger() -> None:
+    with _ledger_lock:
+        for k in list(_ledger):
+            _ledger[k] = 0
+
+
+# ---------------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------------
+
+
+def backoff_delay(
+    attempt: int,
+    what: str = "",
+    base: Optional[float] = None,
+    cap: Optional[float] = None,
+    jitter: Optional[float] = None,
+    seed: Optional[int] = None,
+) -> float:
+    """Delay before transient retry ``attempt`` (1-based): exponential
+    ``base * 2^(attempt-1)`` capped at ``cap``, times a DETERMINISTIC
+    jitter factor in ``[1, 1+jitter]`` seeded from ``(seed, what,
+    attempt)`` — reruns of the same failing dispatch sleep the same
+    schedule, so fault-injected tests are reproducible while distinct
+    blocks still decorrelate."""
+    from .. import config as _config
+
+    cfg = _config.get()
+    base = cfg.retry_backoff_base_s if base is None else base
+    cap = cfg.retry_backoff_max_s if cap is None else cap
+    jitter = cfg.retry_jitter if jitter is None else jitter
+    seed = cfg.retry_seed if seed is None else seed
+    delay = min(float(cap), float(base) * (2.0 ** max(0, attempt - 1)))
+    if jitter:
+        # crc32 keyed by (seed, what, attempt): stable across processes
+        # (unlike hash(), which randomizes strings per interpreter)
+        h = zlib.crc32(f"{seed}|{what}|{attempt}".encode())
+        delay *= 1.0 + float(jitter) * ((h & 0xFFFF) / 65535.0)
+    return delay
+
+
+# ---------------------------------------------------------------------------
+# classified retry
+# ---------------------------------------------------------------------------
+
+
+class FaultScope:
+    """One verb call's fault-handling state: the per-block attempt cap
+    and the verb-wide retry budget. Sites create one scope per verb
+    call and route every block dispatch through `dispatch`."""
+
+    def __init__(
+        self,
+        verb: str,
+        attempts: Optional[int] = None,
+        budget: Optional[int] = None,
+    ):
+        from .. import config as _config
+
+        cfg = _config.get()
+        self.verb = verb
+        self.attempts = (
+            cfg.block_retry_attempts if attempts is None else int(attempts)
+        )
+        self.budget = (
+            cfg.verb_retry_budget if budget is None else int(budget)
+        )
+
+    def dispatch(
+        self,
+        thunk: Callable[[], object],
+        what: str = "block",
+        sched=None,
+        index: Optional[int] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        """Run a zero-arg dispatch ``thunk`` with classified fault
+        handling:
+
+        - ``deterministic`` → re-raise after exactly one attempt;
+        - ``resource`` → re-raise immediately (the CALLER owns block
+          splitting — it needs the feed slices and the combine recipe);
+        - ``transient`` → evict the failing device from the schedule
+          (``sched``/``index`` given: circuit-breaks the device and
+          re-places its unissued blocks — see `BlockSchedule.evict`),
+          sleep the deterministic backoff, and re-invoke the thunk —
+          `BlockSchedule.bind` reads the slot at call time, so the
+          retry lands on the re-placed device. Gives up when the
+          per-block attempts or the verb budget run out and re-raises
+          the last transient error.
+        """
+        from ..utils import telemetry as _tele
+
+        attempt = 0
+        while True:
+            try:
+                return thunk()
+            except Exception as e:  # noqa: BLE001 — classified below
+                cls = classify(e)
+                _note(cls)
+                if cls != TRANSIENT:
+                    if cls == DETERMINISTIC:
+                        _note("failfast")
+                    raise
+                if attempt >= self.attempts or self.budget <= 0:
+                    _log.warning(
+                        "%s: transient failure, retries exhausted "
+                        "(attempt %d/%d, verb budget %d left): %s",
+                        what, attempt + 1, self.attempts + 1,
+                        self.budget, e,
+                    )
+                    raise
+                attempt += 1
+                self.budget -= 1
+                _note("retries")
+                _tele.counter_inc(
+                    "fault_retries", 1.0, **{"class": TRANSIENT}
+                )
+                evicted = None
+                if sched is not None and index is not None:
+                    evicted = sched.evict(index)
+                delay = backoff_delay(attempt, what)
+                _log.warning(
+                    "%s: transient failure (attempt %d/%d)%s — retrying "
+                    "in %.3fs: %s",
+                    what, attempt, self.attempts + 1,
+                    f", evicted device {evicted}" if evicted else "",
+                    delay, e,
+                )
+                with _tele.span(
+                    "fault.retry", kind="fault", what=what,
+                    attempt=attempt, device=evicted,
+                    **{"class": TRANSIENT},
+                ):
+                    sleep(delay)
+
+
+def scope(
+    verb: str,
+    attempts: Optional[int] = None,
+    budget: Optional[int] = None,
+) -> FaultScope:
+    """One `FaultScope` per verb call (reads the config at entry, so a
+    scoped ``config.override`` covers the whole verb)."""
+    return FaultScope(verb, attempts=attempts, budget=budget)
+
+
+def run_with_retries(
+    fn: Callable,
+    *args,
+    attempts: int = 0,
+    what: str = "block",
+    verb: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Classified drop-in for the old blanket retry: call ``fn(*args)``;
+    TRANSIENT errors get up to ``attempts`` extra attempts with
+    backoff, ``resource``/``deterministic`` errors surface after
+    exactly one attempt (the CHANGED semantics — the old version burned
+    every attempt on a `FloatingPointError` before re-raising it). The
+    standalone form for single-dispatch sites (mesh programs, combines,
+    segment aggregation) that have no schedule to fail over."""
+    s = FaultScope(verb or what, attempts=attempts)
+    return s.dispatch(lambda: fn(*args), what=what, sleep=sleep)
+
+
+# ---------------------------------------------------------------------------
+# OOM split support
+# ---------------------------------------------------------------------------
+
+
+def split_allowed(n_rows: int, depth: int) -> bool:
+    """A resource-classified block of ``n_rows`` at recursion ``depth``
+    may split once more: at least 2 rows to halve, and bounded depth
+    (``config.oom_split_depth``) so a genuinely-too-small memory budget
+    degenerates into the original error, not infinite recursion."""
+    from .. import config as _config
+
+    return n_rows > 1 and depth < _config.get().oom_split_depth
+
+
+def combine_split_partials(
+    combiners: Sequence[str],
+    left: Sequence,
+    right: Sequence,
+    n_left: int,
+    n_right: int,
+):
+    """Monoid-combine the per-fetch partials of a split reduce block:
+    ``sum``→add, ``prod``→multiply, ``min``/``max``→elementwise, and
+    ``mean``→row-count-weighted average (exact: the halves partition
+    the block's rows). Only graphs the chunk classifier
+    (`aggregate._chunk_combiners`) proved reducible this way ever reach
+    a split — unclassifiable reduces re-raise the original OOM."""
+    import jax.numpy as jnp
+
+    out = []
+    for comb, a, b in zip(combiners, left, right):
+        a = jnp.asarray(a)
+        b = jnp.asarray(b)
+        if comb == "sum":
+            out.append(a + b)
+        elif comb == "prod":
+            out.append(a * b)
+        elif comb == "min":
+            out.append(jnp.minimum(a, b))
+        elif comb == "max":
+            out.append(jnp.maximum(a, b))
+        elif comb == "mean":
+            w = float(n_left + n_right)
+            out.append(
+                (
+                    a * jnp.asarray(n_left / w, a.dtype)
+                    + b * jnp.asarray(n_right / w, a.dtype)
+                ).astype(a.dtype)
+            )
+        else:  # pragma: no cover - classifier emits only the tags above
+            raise AssertionError(f"unknown combiner {comb!r}")
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# device-grant watchdog
+# ---------------------------------------------------------------------------
+
+_grant_lock = threading.Lock()
+_grant_granted = False        # a grab succeeded: skip the watchdog thread
+_grant_fallback = None        # a grab timed out: the cached fallback devices
+_grant_warned = False
+
+
+def _reset_grant_state() -> None:  # test hook
+    global _grant_granted, _grant_fallback, _grant_warned
+    with _grant_lock:
+        _grant_granted = False
+        _grant_fallback = None
+        _grant_warned = False
+
+
+def device_grant(
+    grab: Optional[Callable[[], Sequence]] = None,
+    timeout_s: Optional[float] = None,
+    fallback: Optional[Callable[[], Sequence]] = None,
+):
+    """Acquire devices under a watchdog: run ``grab()`` (default
+    ``jax.local_devices``) on a daemon thread and wait ``timeout_s``
+    (default ``config.device_grant_timeout_s``). On timeout — backend
+    init wedged at the device grant, the failure mode a contended
+    shared TPU exhibits — warn LOUDLY once, count
+    ``device_grant_timeouts``, and return ``fallback()`` (default: the
+    CPU backend's devices, which initialize independently of the
+    wedged platform). A successful grab is remembered, so steady-state
+    calls cost one flag read and no thread; a timed-out grab's
+    fallback is cached too (the wedged grab thread is left parked on
+    its daemon thread — re-probing it every call would spawn a thread
+    per verb)."""
+    global _grant_granted, _grant_fallback, _grant_warned
+    from .. import config as _config
+
+    if grab is None:
+        import jax
+
+        grab = jax.local_devices
+    if timeout_s is None:
+        timeout_s = _config.get().device_grant_timeout_s
+    with _grant_lock:
+        if _grant_fallback is not None:
+            return list(_grant_fallback)
+        granted = _grant_granted
+    if granted or not timeout_s or timeout_s <= 0:
+        out = grab()
+        with _grant_lock:
+            _grant_granted = True
+        return list(out)
+
+    box: dict = {}
+    done = threading.Event()
+
+    def _worker():
+        try:
+            box["devices"] = grab()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=_worker, daemon=True, name="tfs-device-grant"
+    )
+    t.start()
+    if done.wait(float(timeout_s)):
+        if "error" in box:
+            raise box["error"]
+        with _grant_lock:
+            _grant_granted = True
+        return list(box["devices"])
+
+    # wedged at grant: fall back
+    _note("grant_timeouts")
+    from ..utils import telemetry as _tele
+
+    _tele.counter_inc("device_grant_timeouts")
+    if fallback is None:
+        import jax
+
+        def fallback():
+            return jax.local_devices(backend="cpu")
+
+    try:
+        fb = list(fallback())
+    except Exception as e:
+        raise TimeoutError(
+            f"device grant did not complete within {timeout_s}s "
+            f"(config.device_grant_timeout_s) and the fallback failed: "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    with _grant_lock:
+        _grant_fallback = list(fb)
+        warned = _grant_warned
+        _grant_warned = True
+    if not warned:
+        _log.warning(
+            "device grant did not complete within %.1fs "
+            "(config.device_grant_timeout_s / TFS_DEVICE_GRANT_TIMEOUT_S)"
+            " — the accelerator backend appears WEDGED at device "
+            "acquisition; falling back to %d CPU device(s) for this "
+            "process. Performance will be degraded; restart once the "
+            "accelerator is reachable.",
+            float(timeout_s), len(fb),
+        )
+    return list(fb)

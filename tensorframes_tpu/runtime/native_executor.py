@@ -264,6 +264,10 @@ class NativeExecutor:
                 self.cache_misses += 1
             else:
                 self.cache_hits += 1
+        from . import executor as _exmod
+
+        if _exmod._fault_injector is not None:  # shared injection seam
+            fn = _exmod._fault_injector(fn, key)
         return fn
 
     def callable_for(

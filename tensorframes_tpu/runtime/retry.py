@@ -1,40 +1,20 @@
-"""Failure handling: deterministic block re-execution.
+"""Failure handling shims: classified retry + the numerics guard.
 
-The reference outsourced fault tolerance to Spark's task retry + lineage
-recomputation (SURVEY.md §5: worker kernels are pure functions of
-(broadcast graph, partition rows), so a failed task is simply re-run).
-The same property holds here — every block execution is a pure function
-of (compiled executable, block arrays) — so the framework's retry is a
-plain re-invocation: enable with ``tfs.config.update(
-block_retry_attempts=N)``. Transient device/runtime errors (preempted
-chip, dropped tunnel RPC) get N extra attempts; deterministic errors
-fail after exhausting them with the original exception.
+The blanket retry that used to live here (re-invoke N times on ANY
+exception) grew into the fault-tolerance layer in `runtime.faults`:
+errors are now CLASSIFIED (transient / resource / deterministic),
+transient retries back off exponentially with deterministic jitter, and
+deterministic errors surface after exactly one attempt instead of
+burning the whole budget. `run_with_retries` is re-exported so existing
+imports keep resolving; `maybe_check_numerics` (the CheckNumerics role
+for every verb output) still lives here.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from .faults import run_with_retries  # noqa: F401  (compat re-export)
 
-from ..utils.log import get_logger
-
-__all__ = ["run_with_retries"]
-
-_log = get_logger("retry")
-
-
-def run_with_retries(fn: Callable, *args, attempts: int = 0, what: str = "block"):
-    """Call ``fn(*args)``; on exception retry up to ``attempts`` times."""
-    for attempt in range(attempts + 1):
-        try:
-            return fn(*args)
-        except Exception as e:  # noqa: BLE001 — Spark-style blanket retry
-            if attempt >= attempts:
-                raise
-            _log.warning(
-                "%s execution failed (attempt %d/%d): %s — retrying",
-                what, attempt + 1, attempts + 1, e,
-            )
-    raise AssertionError("unreachable")
+__all__ = ["run_with_retries", "maybe_check_numerics"]
 
 
 def maybe_check_numerics(fetch_names, outs, what: str):

@@ -43,12 +43,15 @@ in-process JAX backend next to it.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional, Sequence, Tuple
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = [
     "BlockSchedule",
+    "DeviceHealth",
+    "device_health",
     "device_label",
     "plan",
     "resolve",
@@ -57,6 +60,149 @@ __all__ = [
 ]
 
 _MODES = ("auto", "on", "off")
+
+
+# ---------------------------------------------------------------------------
+# device health: the failover circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class DeviceHealth:
+    """Per-device circuit breaker keyed by device label.
+
+    State machine per device: *closed* (healthy — no entry in the
+    table) → a transient dispatch failure OPENS the circuit for
+    ``config.device_cooldown_s`` (doubling on repeated failures, capped
+    at 8x) → after the cooldown the next `usable` check transitions to
+    *half-open* and admits the device to ONE probing schedule (that
+    check's caller; further `usable` checks exclude it again until the
+    probe reaches a verdict, re-arming after another cooldown in case
+    the probing schedule never dispatched to it) → a successful
+    dispatch closes the circuit (entry removed), a failure re-opens it
+    with the doubled cooldown. `resolve` filters circuit-open devices
+    out of auto/on scheduling, so an evicted device's remaining blocks
+    re-place onto healthy devices; explicit ``devices=`` pins bypass
+    the filter (loudly).
+
+    All timestamps ride an injectable ``now`` (monotonic seconds) so
+    the state machine unit-tests without sleeping."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: Dict[str, Dict] = {}
+
+    def mark_failure(self, label: str, now: Optional[float] = None) -> None:
+        """A transient dispatch failure on ``label``: open (or re-open,
+        with doubled cooldown) its circuit and count the eviction."""
+        from .. import config as _config
+        from ..utils import telemetry as _tele
+        from ..utils.log import get_logger
+        from . import faults as _faults
+
+        now = time.monotonic() if now is None else now
+        base = max(1e-3, float(_config.get().device_cooldown_s))
+        with self._lock:
+            st = self._states.get(label)
+            if st is None:
+                st = {
+                    "state": "open", "failures": 0, "cooldown": base,
+                    "until": 0.0, "warned_pin": False,
+                }
+                self._states[label] = st
+            else:
+                st["state"] = "open"
+                st["cooldown"] = min(st["cooldown"] * 2.0, base * 8.0)
+            st["failures"] += 1
+            st["until"] = now + st["cooldown"]
+            cooldown = st["cooldown"]
+        _faults.note_eviction()
+        _tele.counter_inc("device_evictions", 1.0, device=label)
+        get_logger("scheduler").warning(
+            "device %s evicted after a transient dispatch failure; "
+            "circuit open for %.1fs (half-open probe after cooldown)",
+            label, cooldown,
+        )
+
+    def mark_success(self, label: str) -> None:
+        """A successful dispatch on ``label``: closes a half-open
+        circuit (the probe passed). Fast path: no table entries, no
+        lock contention — the steady state costs one dict check."""
+        if not self._states:
+            return
+        with self._lock:
+            st = self._states.get(label)
+            if st is not None and st["state"] == "half-open":
+                del self._states[label]
+
+    def usable(self, label: str, now: Optional[float] = None) -> bool:
+        """True when ``label`` may receive dispatches: circuit closed,
+        or open-past-cooldown (transitions to half-open and admits ONE
+        probing caller — later checks exclude the device again until
+        the probe's verdict, re-arming after another cooldown so a
+        probe that never dispatched cannot strand the device)."""
+        if not self._states:
+            return True
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._states.get(label)
+            if st is None:
+                return True
+            if st["state"] == "open":
+                if now >= st["until"]:
+                    st["state"] = "half-open"
+                    st["probe_rearm"] = now + st["cooldown"]
+                    return True
+                return False
+            # half-open: the transition call above was the probe
+            # admission; everyone else waits for the verdict (or for
+            # the re-arm window, if the probing schedule never ran)
+            if now >= st.get("probe_rearm", 0.0):
+                st["probe_rearm"] = now + st["cooldown"]
+                return True
+            return False
+
+    def filter(self, devices: Sequence, now: Optional[float] = None) -> List:
+        return [d for d in devices if self.usable(device_label(d), now)]
+
+    def table(self) -> List[Dict]:
+        """Snapshot for `tfs.diagnostics()`: one row per non-closed
+        circuit (an empty table means every device is healthy)."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "device": label,
+                    "state": st["state"],
+                    "failures": st["failures"],
+                    "cooldown_s": round(st["cooldown"], 3),
+                    "retry_in_s": round(max(0.0, st["until"] - now), 3),
+                }
+                for label, st in sorted(self._states.items())
+            ]
+
+    def warn_pinned(self, label: str) -> bool:
+        """Explicit ``devices=`` pins opt out of failover — but a pin
+        onto a circuit-open device deserves one loud warning per
+        episode. Returns True when the warning should fire."""
+        with self._lock:
+            st = self._states.get(label)
+            if st is None or st["warned_pin"]:
+                return False
+            st["warned_pin"] = True
+            return True
+
+    def reset(self) -> None:
+        with self._lock:
+            self._states.clear()
+
+
+_health = DeviceHealth()
+
+
+def device_health() -> DeviceHealth:
+    """The process-wide device-health registry (one circuit breaker per
+    device label, shared by every schedule)."""
+    return _health
 
 
 def device_label(dev) -> str:
@@ -89,6 +235,17 @@ def plan(weights: Sequence[int], ndev: int) -> List[Optional[int]]:
 def _local_devices() -> List:
     import jax
 
+    from .. import config as _config
+
+    t = _config.get().device_grant_timeout_s
+    if t and t > 0:
+        # device-grant watchdog: a wedged accelerator backend (stuck at
+        # device grant — the shared-TPU failure mode) times out here and
+        # the process degrades to the CPU backend with a loud one-time
+        # warning instead of hanging forever
+        from . import faults as _faults
+
+        return list(_faults.device_grant(grab=jax.local_devices, timeout_s=t))
     return list(jax.local_devices())
 
 
@@ -150,7 +307,22 @@ def resolve(
                 f"scheduling; {type(executor).__name__} does not (the "
                 "native host owns its own device)"
             )
-        return _normalize_devices(devices)
+        devs = _normalize_devices(devices)
+        # pins opt OUT of failover — loudly: a pin onto a circuit-open
+        # device is deliberate placement, but the operator should know
+        # the scheduler would have avoided it
+        for d in devs:
+            lab = device_label(d)
+            if not _health.usable(lab) and _health.warn_pinned(lab):
+                from ..utils.log import get_logger
+
+                get_logger("scheduler").warning(
+                    "devices= pins dispatches to %s, whose failover "
+                    "circuit is OPEN after transient failures; explicit "
+                    "pins bypass device failover",
+                    lab,
+                )
+        return devs
     if not supported:
         return None
     from .. import config as _config
@@ -168,7 +340,20 @@ def resolve(
     devs = _local_devices()
     if mode == "auto" and len(devs) < 2:
         return None
-    return tuple(devs)
+    # failover: circuit-open devices drop out of auto/on scheduling
+    # until their cooldown elapses (then ONE half-open probe re-admits
+    # them on success). With every device evicted there is nothing left
+    # to fail over to: schedule the full set rather than nothing.
+    healthy = _health.filter(devs)
+    if not healthy:
+        from ..utils.log import get_logger
+
+        get_logger("scheduler").warning(
+            "every local device's failover circuit is open; scheduling "
+            "over the full device set anyway"
+        )
+        healthy = devs
+    return tuple(healthy)
 
 
 class BlockSchedule:
@@ -182,16 +367,25 @@ class BlockSchedule:
     callers that invoke the program themselves."""
 
     __slots__ = (
-        "devices", "labels", "assignment", "executor", "_remaining",
-        "_lock",
+        "devices", "labels", "assignment", "executor", "weights",
+        "_issued", "_remaining", "_lock",
     )
 
     def __init__(self, devices: Tuple, assignment: List[Optional[int]],
-                 executor=None):
+                 executor=None, weights: Optional[Sequence[int]] = None):
         self.devices = tuple(devices)
         self.labels = tuple(device_label(d) for d in self.devices)
         self.assignment = list(assignment)
         self.executor = executor
+        # per-item weights (row counts): what `evict` re-places by.
+        # Callers constructing BlockSchedule directly (tests) may omit
+        # them — failover then re-places with unit weights.
+        self.weights = (
+            [1 if s is not None else 0 for s in self.assignment]
+            if weights is None
+            else [int(w) for w in weights]
+        )
+        self._issued = [False] * len(self.assignment)
         self._remaining = [0] * len(self.devices)
         for s in self.assignment:
             if s is not None:
@@ -229,7 +423,12 @@ class BlockSchedule:
             return list(feeds)
         dev = self.devices[s]
         out = [jax.device_put(f, dev) for f in feeds]
-        self._note_dispatch(s)
+        self._note_dispatch(i, s)
+        # put-path verbs (reduce_rows folds, chunked aggregation) are
+        # the only dispatches some workloads ever issue — a successful
+        # transfer onto the device must close its half-open circuit
+        # too, or a probe could hang in half-open forever
+        _health.mark_success(self.labels[s])
         return out
 
     def bind(self, i: int, fn, valid=None):
@@ -239,12 +438,15 @@ class BlockSchedule:
         (`shape_policy.build_masked_reduce`'s calling convention).
         Detects per-device jit compiles by watching the program's jit
         cache across the call (best-effort under concurrent verbs —
-        same caveat as `Executor._instrument`)."""
-        s = self.assignment[i]
+        same caveat as `Executor._instrument`). The slot is read at
+        CALL time, so a thunk rebuilt after `evict` re-placed the item
+        dispatches to the item's NEW device; a successful call feeds
+        the device-health registry (closes a half-open circuit)."""
 
         def call(*feeds):
             import jax
 
+            s = self.assignment[i]
             if s is None:
                 return fn(*feeds) if valid is None else fn(
                     np.int32(valid), *feeds
@@ -270,16 +472,70 @@ class BlockSchedule:
                 if n1 is not None and n1 > n0:
                     _bump(self.executor, "device_compiles",
                           self.labels[s], n1 - n0)
-            self._note_dispatch(s)
+            self._note_dispatch(i, s)
+            _health.mark_success(self.labels[s])
             return out
 
         return call
 
-    def _note_dispatch(self, s: int) -> None:
+    def evict(self, index: int) -> Optional[str]:
+        """Failover after a transient failure of item ``index``: open
+        the circuit of its device (`DeviceHealth.mark_failure`) and
+        re-place every not-yet-issued item — including ``index``
+        itself — LPT onto the remaining usable devices, on top of the
+        load the already-issued items put there. Already-computed
+        partials stay where they are (their buffers are assumed
+        readable — a HARD device loss surfaces at the combine and
+        fails the verb after the budget). Returns the evicted device's
+        label, or None when the item was unscheduled or no other
+        usable device exists — in which case NOTHING is counted or
+        circuit-opened: the retry re-runs in place, and an "eviction"
+        with nowhere to go would overcount the re-placement metric
+        (and, on a single-device schedule, open the only circuit)."""
+        s = self.assignment[index]
+        if s is None:
+            return None
+        label = self.labels[s]
+        with self._lock:
+            alive = [
+                t for t in range(self.ndev)
+                if t != s and _health.usable(self.labels[t])
+            ]
+        if not alive:
+            return None
+        _health.mark_failure(label)
+        with self._lock:
+            load = {t: 0 for t in alive}
+            pending: List[int] = []
+            for i, slot in enumerate(self.assignment):
+                if slot is None:
+                    continue
+                if self._issued[i] and i != index:
+                    if slot in load:
+                        load[slot] += self.weights[i]
+                else:
+                    pending.append(i)
+            # LPT over the survivors: heaviest pending item first onto
+            # the least-loaded usable slot — same policy, same
+            # determinism, as the original plan()
+            pending.sort(key=lambda i: (-self.weights[i], i))
+            for i in pending:
+                t = min(alive, key=lambda a: (load[a], a))
+                load[t] += max(1, self.weights[i])
+                self.assignment[i] = t
+            # rebuild the queue-depth ledger from the new assignment
+            self._remaining = [0] * self.ndev
+            for i, slot in enumerate(self.assignment):
+                if slot is not None and not self._issued[i]:
+                    self._remaining[slot] += 1
+        return label
+
+    def _note_dispatch(self, i: int, s: int) -> None:
         _bump(self.executor, "device_dispatches", self.labels[s], 1)
         from ..utils import telemetry as _tele
 
         with self._lock:
+            self._issued[i] = True
             self._remaining[s] = max(0, self._remaining[s] - 1)
             depth = self._remaining[s]
         if _tele.enabled():
@@ -315,7 +571,9 @@ def schedule_weights(
     devs = resolve(devices=devices, executor=executor, mesh=mesh)
     if devs is None:
         return None
-    return BlockSchedule(devs, plan(weights, len(devs)), executor=executor)
+    return BlockSchedule(
+        devs, plan(weights, len(devs)), executor=executor, weights=weights
+    )
 
 
 def schedule_for(

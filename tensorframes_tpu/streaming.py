@@ -73,13 +73,28 @@ def _prefetch_iter(it, depth: int = 1, stage=None):
     )
     put_raw = _make_put(q_raw)
 
+    def _stamp(e: BaseException, idx: int, stage_name: str) -> BaseException:
+        # chunk-index context for pipeline failures: the consumer sees
+        # WHICH chunk (and which pipeline stage) died without the
+        # exception type changing — `tfs_chunk_index` rides as an
+        # attribute and the re-raise site logs it
+        if getattr(e, "tfs_chunk_index", None) is None:
+            try:
+                e.tfs_chunk_index = idx
+                e.tfs_pipeline_stage = stage_name
+            except Exception:
+                pass  # extension exceptions without a __dict__
+        return e
+
     def producer():
+        idx = 0
         try:
             for item in it:
                 if not put_raw(("item", item)):
                     return
+                idx += 1
         except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
-            put_raw(("error", e))
+            put_raw(("error", _stamp(e, idx, "producer")))
             return
         put_raw(("end", _END))
 
@@ -92,6 +107,7 @@ def _prefetch_iter(it, depth: int = 1, stage=None):
         put_out = _make_put(q_out)
 
         def stager():
+            idx = 0
             while not cancelled.is_set():
                 try:  # bounded get: exit promptly on consumer abandon
                     kind, payload = q_raw.get(timeout=0.1)
@@ -101,8 +117,9 @@ def _prefetch_iter(it, depth: int = 1, stage=None):
                     try:
                         payload = stage(payload)
                     except BaseException as e:  # noqa: BLE001 — consumer side
-                        put_out(("error", e))
+                        put_out(("error", _stamp(e, idx, "transfer-stage")))
                         return
+                    idx += 1
                 if not put_out((kind, payload)):
                     return
                 if kind != "item":
@@ -121,6 +138,17 @@ def _prefetch_iter(it, depth: int = 1, stage=None):
                 _tele.gauge_set("stream_queue_depth", q_out.qsize())
             kind, payload = q_out.get()
             if kind == "error":
+                idx = getattr(payload, "tfs_chunk_index", None)
+                if idx is not None:
+                    from .utils.log import get_logger
+
+                    get_logger("streaming").warning(
+                        "stream pipeline failed at chunk %d (%s stage): "
+                        "%s: %s",
+                        idx,
+                        getattr(payload, "tfs_pipeline_stage", "?"),
+                        type(payload).__name__, payload,
+                    )
                 raise payload
             if kind == "end":
                 return

@@ -1,0 +1,9 @@
+"""Testing utilities: the deterministic fault-injection harness.
+
+Not imported by the library itself — test suites and chaos benchmarks
+opt in with ``from tensorframes_tpu.testing import faults``.
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

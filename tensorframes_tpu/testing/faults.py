@@ -1,0 +1,222 @@
+"""Deterministic fault-injection harness for the dispatch runtime.
+
+Chaos engineering for a framework whose kernels are pure functions:
+wrap the `Executor.cached` boundary — the one seam EVERY dispatch
+crosses (block maps, vmapped rows, scan folds, combines, shard_map
+programs, segment aggregations) — and raise classified faults
+(`InjectedFault`, stamped with ``tfs_fault_class`` so
+`runtime.faults.classify` recognizes them without pattern matching) on
+a SEEDED, reproducible subset of dispatches.
+
+Usage::
+
+    from tensorframes_tpu.testing import faults as chaos
+
+    with chaos.inject(rate=0.3, seed=7, fault="transient") as plan:
+        out = tfs.reduce_blocks(s, df)      # ~30% of dispatches fault
+    assert plan.injected > 0
+
+    with chaos.inject(nth=[2], fault="resource"):
+        tfs.map_blocks(z, df)               # dispatch #2 OOMs once
+
+Determinism: every wrapped invocation draws a per-ordinal verdict from
+``random.Random(seed * PRIME + ordinal)`` — the dispatch ordinal
+sequence is fixed for a fixed workload, so two runs with the same seed
+fault the same dispatches, sleep the same (seeded) backoff, and
+produce bit-identical results. Retries and split halves are NEW
+ordinals, so a retried dispatch is re-drawn (and an ``nth`` fault
+fires exactly once).
+
+Filters compose conjunctively:
+
+- ``rate``/``nth`` — which ordinals fault;
+- ``kind`` — cache-kind prefix (``"block"``, ``"reduce-combine"``,
+  ``"vmap-rows"``, ``"shmap-"`` ...);
+- ``program`` — graph-fingerprint prefix;
+- ``device`` — the device label (``cpu:3``) the dispatch's committed
+  feed arrays live on (set by the block scheduler's ``device_put``);
+- ``max_faults`` — total injection budget.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import random
+import threading
+from typing import Iterable, Optional, Sequence
+
+from ..runtime import executor as _exmod
+from ..runtime import faults as _rt_faults
+
+__all__ = ["InjectedFault", "FaultPlan", "inject"]
+
+_PRIME = 1_000_003
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the harness. Carries ``tfs_fault_class`` (what
+    `runtime.faults.classify` honors first) plus the dispatch ordinal
+    and cache kind for assertion messages."""
+
+    def __init__(self, message: str, fault_class: str, ordinal: int,
+                 kind: str):
+        super().__init__(message)
+        self.tfs_fault_class = fault_class
+        self.ordinal = ordinal
+        self.kind = kind
+
+
+def _args_device_label(args) -> Optional[str]:
+    """Device label of the first single-device jax.Array argument (the
+    scheduler commits feeds with `device_put` BEFORE the program runs,
+    so a scheduled dispatch's placement is visible here)."""
+    try:
+        import jax
+
+        for a in args:
+            if isinstance(a, jax.Array):
+                ds = a.devices()
+                if len(ds) == 1:
+                    d = next(iter(ds))
+                    return (
+                        f"{getattr(d, 'platform', 'dev')}:"
+                        f"{getattr(d, 'id', '?')}"
+                    )
+    except Exception:
+        pass
+    return None
+
+
+class FaultPlan:
+    """One active injection campaign (thread-safe dispatch counter +
+    verdict bookkeeping)."""
+
+    def __init__(
+        self,
+        rate: float = 0.0,
+        seed: int = 0,
+        fault: str = _rt_faults.TRANSIENT,
+        nth: Optional[Iterable[int]] = None,
+        kind: Optional[str] = None,
+        program: Optional[str] = None,
+        device: Optional[str] = None,
+        max_faults: Optional[int] = None,
+    ):
+        if fault not in (
+            _rt_faults.TRANSIENT, _rt_faults.RESOURCE,
+            _rt_faults.DETERMINISTIC,
+        ):
+            raise ValueError(f"unknown fault class {fault!r}")
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.fault = fault
+        self.nth = None if nth is None else {int(n) for n in nth}
+        self.kind = kind
+        self.program = program
+        self.device = device
+        self.max_faults = max_faults
+        self._lock = threading.Lock()
+        self._ordinal = 0
+        self._fired: set = set()
+        self.injected = 0
+        self.dispatches = 0
+        self.faulted_ordinals: list = []
+        self.faulted_devices: list = []
+
+    # -- verdicts -------------------------------------------------------
+    def _next_ordinal(self) -> int:
+        with self._lock:
+            o = self._ordinal
+            self._ordinal += 1
+            self.dispatches += 1
+            return o
+
+    def _ordinal_fires(self, ordinal: int) -> bool:
+        if self.nth is not None:
+            return ordinal in self.nth and ordinal not in self._fired
+        if self.rate <= 0.0:
+            return False
+        return random.Random(self.seed * _PRIME + ordinal).random() < self.rate
+
+    def _should_fire(self, ordinal: int, key, args) -> bool:
+        if self.max_faults is not None and self.injected >= self.max_faults:
+            return False
+        if self.kind is not None and not str(key[0]).startswith(self.kind):
+            return False
+        if self.program is not None and not str(key[1]).startswith(
+            self.program
+        ):
+            return False
+        if not self._ordinal_fires(ordinal):
+            return False
+        if self.device is not None:
+            if _args_device_label(args) != self.device:
+                return False
+        return True
+
+    # -- the Executor.cached hook --------------------------------------
+    def _hook(self, fn, key):
+        plan = self
+
+        def wrapper(*args, **kwargs):
+            ordinal = plan._next_ordinal()
+            if plan._should_fire(ordinal, key, args):
+                dev = _args_device_label(args)
+                with plan._lock:
+                    plan._fired.add(ordinal)
+                    plan.injected += 1
+                    plan.faulted_ordinals.append(ordinal)
+                    plan.faulted_devices.append(dev)
+                tag = {
+                    _rt_faults.TRANSIENT: "UNAVAILABLE: injected device loss",
+                    _rt_faults.RESOURCE:
+                        "RESOURCE_EXHAUSTED: injected out of memory",
+                    _rt_faults.DETERMINISTIC: "injected deterministic error",
+                }[plan.fault]
+                raise InjectedFault(
+                    f"{tag} (dispatch #{ordinal}, kind={key[0]!r}"
+                    f"{', device=' + dev if dev else ''})",
+                    plan.fault, ordinal, str(key[0]),
+                )
+            return fn(*args, **kwargs)
+
+        # re-expose the jit cache handle: the scheduler's per-device
+        # compile detection and shape-compile introspection read it off
+        # whatever callable they were handed
+        sizer = getattr(fn, "_cache_size", None)
+        if callable(sizer):
+            wrapper._cache_size = sizer
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+
+@contextlib.contextmanager
+def inject(
+    rate: float = 0.0,
+    seed: int = 0,
+    fault: str = _rt_faults.TRANSIENT,
+    nth: Optional[Sequence[int]] = None,
+    kind: Optional[str] = None,
+    program: Optional[str] = None,
+    device: Optional[str] = None,
+    max_faults: Optional[int] = None,
+):
+    """Install a `FaultPlan` on the executor seam for the enclosed
+    block; yields the plan (inspect ``plan.injected`` /
+    ``plan.dispatches`` / ``plan.faulted_ordinals`` afterwards). One
+    plan at a time — nesting raises, because two plans sharing one
+    ordinal counter would silently change each other's draws."""
+    if _exmod._fault_injector is not None:
+        raise RuntimeError(
+            "a fault-injection plan is already active; nest-free by "
+            "design (ordinal determinism)"
+        )
+    plan = FaultPlan(
+        rate=rate, seed=seed, fault=fault, nth=nth, kind=kind,
+        program=program, device=device, max_faults=max_faults,
+    )
+    _exmod.set_fault_injector(plan._hook)
+    try:
+        yield plan
+    finally:
+        _exmod.set_fault_injector(None)

@@ -120,6 +120,13 @@ def executor_stats(executor=None) -> Dict[str, int]:
                     out[key] = dict(sorted(ledger.items()))
             else:
                 out[key] = dict(sorted(ledger.items()))
+    # fault ledger (`runtime.faults`): classified failure counts and
+    # what the runtime did about them (retries / splits / device
+    # evictions / fail-fasts / grant timeouts). Process-wide — faults
+    # are a dispatch-path property, not an executor-cache one.
+    from ..runtime import faults as _faults
+
+    out["faults"] = _faults.ledger_snapshot()
     return out
 
 

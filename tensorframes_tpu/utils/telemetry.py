@@ -852,6 +852,36 @@ def diagnostics(executor=None) -> str:
                 f"host_sync={p['host_sync_s']:.4f}s"
             )
 
+    # fault tolerance: device health + the fault ledger -----------------
+    try:
+        from ..runtime import faults as _faults
+        from ..runtime.scheduler import device_health
+
+        health = device_health().table()
+        ledger = _faults.ledger_snapshot()
+        lines.append("")
+        if health:
+            lines.append(
+                "device health (failover circuit breaker; closed "
+                "circuits are not listed):"
+            )
+            for row in health:
+                lines.append(
+                    f"  {row['device']:<10} {row['state']:<9} "
+                    f"failures={row['failures']} "
+                    f"cooldown={row['cooldown_s']}s "
+                    f"retry_in={row['retry_in_s']}s"
+                )
+        else:
+            lines.append("device health: all devices healthy")
+        if any(ledger.values()):
+            lines.append(
+                "faults: "
+                + " ".join(f"{k}={v}" for k, v in sorted(ledger.items()))
+            )
+    except Exception as e:  # diagnostics must never raise
+        lines.append(f"fault state unavailable: {type(e).__name__}: {e}")
+
     # executor + recompile-storm signal ---------------------------------
     try:
         es = executor_stats(executor)

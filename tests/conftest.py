@@ -33,8 +33,15 @@ def _reset_telemetry():
     analogue of the `reset_stats()` discipline stats-asserting tests
     already follow): every test ends with a full `telemetry.reset()` —
     spans, counters, gauges, histograms — so a test that asserts on the
-    ring or the registry always starts from the previous test's reset."""
+    ring or the registry always starts from the previous test's reset.
+    Fault state resets with it: a chaos test's device evictions
+    (circuit breakers are process-global) and ledger counts must never
+    bleed into the next test's scheduling."""
     yield
+    from tensorframes_tpu.runtime import faults
+    from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
     telemetry.reset()
+    faults.reset_ledger()
+    device_health().reset()

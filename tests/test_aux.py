@@ -135,8 +135,11 @@ class TestFluentAPI:
 
 
 class TestRetry:
+    """Classified retry (`runtime.faults`): TRANSIENT errors consume
+    attempts with backoff; deterministic errors fail after exactly one
+    attempt (the old blanket retry burned all N attempts on them)."""
+
     def test_flaky_block_recovers(self):
-        import tensorframes_tpu as tfs
         from tensorframes_tpu import config
 
         calls = {"n": 0}
@@ -144,27 +147,54 @@ class TestRetry:
         def flaky(x):
             calls["n"] += 1
             if calls["n"] == 1:
-                raise RuntimeError("transient")
+                # a transient-classified runtime status (the XLA
+                # "device went away" family)
+                raise RuntimeError("UNAVAILABLE: injected device loss")
             return {"y": x + 1.0}
 
-        df = tfs.TensorFrame.from_dict({"x": np.arange(3.0)})
-        with config.override(block_retry_attempts=2):
-            # function front-end doesn't use the retry path; use graph path
-            # with a monkeypatched executor callable
+        with config.override(retry_backoff_base_s=0.001):
             from tensorframes_tpu.runtime.retry import run_with_retries
 
             out = run_with_retries(flaky, np.arange(3.0), attempts=2)
         np.testing.assert_array_equal(out["y"], np.arange(3.0) + 1)
         assert calls["n"] == 2
 
-    def test_exhausted_retries_raise(self):
+    def test_transient_exhausted_raises_original(self):
+        from tensorframes_tpu import config
         from tensorframes_tpu.runtime.retry import run_with_retries
 
-        def always_fails():
-            raise ValueError("deterministic")
+        calls = {"n": 0}
 
-        with pytest.raises(ValueError, match="deterministic"):
-            run_with_retries(always_fails, attempts=2)
+        def always_unavailable():
+            calls["n"] += 1
+            raise RuntimeError("UNAVAILABLE: still down")
+
+        with config.override(retry_backoff_base_s=0.001):
+            with pytest.raises(RuntimeError, match="still down"):
+                run_with_retries(always_unavailable, attempts=2)
+        assert calls["n"] == 3  # 1 attempt + 2 transient retries
+
+    def test_deterministic_fails_after_one_attempt(self):
+        """Regression (ISSUE 6 satellite): deterministic errors — e.g.
+        `FloatingPointError` from check_numerics, dtype/shape
+        mismatches — must NOT burn the retry budget; the original
+        exception surfaces after exactly one attempt."""
+        from tensorframes_tpu.runtime.retry import run_with_retries
+
+        for exc in (
+            ValueError("deterministic"),
+            FloatingPointError("fetch 'z' contains 1 non-finite value"),
+            TypeError("deterministic"),
+        ):
+            calls = {"n": 0}
+
+            def fails():
+                calls["n"] += 1
+                raise exc
+
+            with pytest.raises(type(exc)):
+                run_with_retries(fails, attempts=5)
+            assert calls["n"] == 1, type(exc)
 
 
 class TestLogging:

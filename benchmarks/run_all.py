@@ -61,6 +61,10 @@ SMOKE_SIZES = {
     "SCHED_CHAIN": "16",
     "CHAOS_ROWS": "100000",
     "CHAOS_BLOCKS": "8",
+    "INGEST_SHARDS": "4",
+    "INGEST_GROUPS": "2",
+    "INGEST_GROUP_ROWS": "20000",
+    "INGEST_ITERS": "2",
 }
 
 
@@ -85,6 +89,7 @@ def main():
         "frozen_inception_v3_bench",
         "ragged_map_rows_bench",
         "stream_overlap_bench",
+        "ingest_bench",
         # LAST THREE: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them

@@ -1,14 +1,18 @@
 """End-to-end Parquet pipeline: the lake-to-device flow Spark users run.
 
-Writes a keyed Parquet dataset (one row group per block), then streams a
-vector reduce over the row groups in BOUNDED host memory
-(`stream_parquet` → `reduce_blocks_stream`), and runs a string-keyed
-aggregate — the `groupBy(k).agg` shape of the reference's README — on
-the loaded table (keyed aggregation needs all rows of a key together;
-for out-of-core keyed data, pre-partition by key or use
-`multihost.aggregate_global` across hosts).
+Writes a keyed MULTI-SHARD Parquet dataset (several files, one row
+group per block — the shape a lake partitioning actually leaves on
+disk), then streams a vector reduce over all shards in BOUNDED host
+memory through the pipelined ingest engine (`stream_dataset` →
+`reduce_blocks_stream`: shard discovery → parallel decode → H2D
+transfer → compute, all overlapped — see ARCHITECTURE.md "Ingest
+pipeline"), and runs a string-keyed aggregate — the `groupBy(k).agg`
+shape of the reference's README — on the loaded table (keyed
+aggregation needs all rows of a key together; for out-of-core keyed
+data, pre-partition by key or use `multihost.aggregate_global` across
+hosts).
 
-    python examples/parquet_pipeline.py [--rows 1000000]
+    python examples/parquet_pipeline.py [--rows 1000000] [--shards 4]
 """
 
 import os
@@ -29,18 +33,22 @@ from tensorframes_tpu import dsl
 from tensorframes_tpu import io as tio
 
 
-def main(rows: int):
+def main(rows: int, shards: int):
     rng = np.random.RandomState(0)
     keys = np.array(["ads", "search", "feed"], dtype=object)
-    df = tfs.TensorFrame.from_dict(
-        {
-            "channel": keys[rng.randint(0, 3, rows)],
-            "spend": rng.rand(rows).astype(np.float32),
-        },
-        num_blocks=max(1, rows // 250_000),
-    )
-    path = os.path.join(tempfile.mkdtemp(), "spend.parquet")
-    tio.write_parquet(df, path)
+    root = tempfile.mkdtemp()
+    shard_rows = max(1, rows // shards)
+    for i in range(shards):
+        n = shard_rows if i < shards - 1 else rows - shard_rows * (shards - 1)
+        f = tfs.TensorFrame.from_dict(
+            {
+                "channel": keys[rng.randint(0, 3, n)],
+                "spend": rng.rand(n).astype(np.float32),
+            },
+            num_blocks=max(1, n // 250_000),
+        )
+        tio.write_parquet(f, os.path.join(root, f"spend-{i:04d}.parquet"))
+        del f  # shards leave host memory: the stream below re-reads disk
 
     probe = tfs.TensorFrame.from_dict({"spend": np.zeros(4, np.float32)})
     s = dsl.reduce_sum(
@@ -49,14 +57,32 @@ def main(rows: int):
 
     t0 = time.perf_counter()
     # results are async device arrays; sync inside each timed region so
-    # the walls cover compute, not just dispatch
+    # the walls cover compute, not just dispatch. stream_dataset
+    # discovers every shard in the directory and decodes them on a
+    # thread pool while earlier chunks compute on device.
     total = jax.block_until_ready(
-        tfs.reduce_blocks_stream(s, tio.stream_parquet(path))
+        tfs.reduce_blocks_stream(s, tfs.stream_dataset(root))
     )
     t_stream = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    full = tio.read_parquet(path)
+    # keyed aggregation needs all rows of a key together: load the
+    # shards back from disk (one at a time) into one frame
+    loaded = [
+        tio.read_parquet(os.path.join(root, name))
+        for name in sorted(os.listdir(root))
+    ]
+    full = tfs.TensorFrame.from_dict(
+        {
+            "channel": np.concatenate(
+                [np.asarray(f["channel"].host_values()) for f in loaded]
+            ),
+            "spend": np.concatenate(
+                [np.asarray(f["spend"].host_values()) for f in loaded]
+            ),
+        }
+    )
+    del loaded
     per_key = tfs.aggregate(s, tfs.group_by(full, "channel"))
     jax.block_until_ready(per_key["spend"].values)
     t_agg = time.perf_counter() - t0
@@ -74,6 +100,7 @@ def main(rows: int):
         json.dumps(
             {
                 "rows": rows,
+                "shards": shards,
                 "stream_total": round(float(total), 2),
                 "stream_s": round(t_stream, 3),
                 "per_channel": {k: round(v, 2) for k, v in got.items()},
@@ -86,5 +113,6 @@ def main(rows: int):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--shards", type=int, default=4)
     args = ap.parse_args()
-    main(args.rows)
+    main(args.rows, args.shards)

@@ -52,6 +52,8 @@ from .graph import builder as dsl
 from .runtime import Executor
 from . import config
 from . import io
+from . import ingest
+from .io import stream_dataset
 from . import utils
 from .utils import telemetry
 from .utils.telemetry import diagnostics
@@ -85,6 +87,8 @@ __all__ = [
     "reduce_blocks_stream",
     "reduce_rows",
     "row",
+    "ingest",
+    "stream_dataset",
     "Graph",
     "ShapeHints",
     "dsl",

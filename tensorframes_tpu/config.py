@@ -121,6 +121,47 @@ class Config:
             ).lower(),
         )
     )
+    # Pipelined ingest (`ingest.pipeline`): stream verbs and the io
+    # readers run shard discovery -> parallel decode -> H2D transfer ->
+    # compute as concurrently-executing stages over bounded queues.
+    # Off = stage-serial: the SAME stage functions run inline on the
+    # consumer thread (no overlap) — the A/B baseline
+    # benchmarks/ingest_bench.py measures against, and an escape hatch
+    # for single-core hosts where pipeline threads only add overhead.
+    # Env override TFS_INGEST_PIPELINE ("0" disables) seeds the initial
+    # value, mirroring TFS_SHAPE_BUCKETING.
+    ingest_pipeline: bool = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "TFS_INGEST_PIPELINE", "1"
+        ).lower() not in ("0", "false", "off")
+    )
+    # Delivery-queue bound of the ingest pipeline (was the hard-coded
+    # depth=1 of `_prefetch_iter`): how many decoded chunks may sit
+    # ready ahead of the consumer. Peak buffered chunks for the
+    # canonical discovery -> decode(W) -> transfer chain is
+    # W + 2*depth + 4 (see ingest/pipeline.py's bound derivation —
+    # asserted in tests/test_ingest.py), so host memory for a stream
+    # is ~that many chunks regardless of stream length. Raise it when
+    # chunk decode time is bursty; lower it when chunks are huge. Env
+    # override TFS_STREAM_PREFETCH_DEPTH seeds the initial value.
+    stream_prefetch_depth: int = dataclasses.field(
+        default_factory=lambda: max(1, int(
+            __import__("os").environ.get("TFS_STREAM_PREFETCH_DEPTH", "1")
+            or "1"
+        ))
+    )
+    # Decode thread-pool width for multi-file datasets
+    # (`ingest.dataset.IngestStream`): 0 = auto (min(4, host cores)).
+    # pyarrow releases the GIL inside Parquet/IPC decode, so workers
+    # scale with real cores; each worker holds at most one chunk plus
+    # the shared reorder window. Env override TFS_INGEST_DECODE_WORKERS
+    # seeds the initial value.
+    ingest_decode_workers: int = dataclasses.field(
+        default_factory=lambda: int(
+            __import__("os").environ.get("TFS_INGEST_DECODE_WORKERS", "0")
+            or "0"
+        )
+    )
     # One-time per-program warning when jit has compiled more than this
     # many distinct input shapes for a single cached program — the
     # recompile-storm signal `compile_count` (distinct lowered callables)

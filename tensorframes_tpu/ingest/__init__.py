@@ -1,0 +1,46 @@
+"""Pipelined out-of-core ingest engine.
+
+The streaming path used to be a fixed producer -> transfer -> consumer
+chain bolted onto `reduce_blocks_stream` (`streaming._prefetch_iter`),
+with `io.py` decoding Parquet/IPC row groups serially on the consumer
+thread and no multi-file support — devices starved whenever decode ran
+slower than compute. This package is the input pipeline as a
+first-class concurrent subsystem ("Extending TensorFlow's Semantics
+with Pipelined Execution", PAPERS.md):
+
+- `pipeline` — the generic stage-graph runtime: N concurrently
+  executing stages over bounded queues, out-of-order parallel workers
+  with in-order delivery, per-stage telemetry, classified fault
+  retries, deterministic cancellation.
+- `dataset` — multi-file shard discovery (directory / glob / explicit
+  list of Parquet or Arrow IPC files, deterministic shard order) and
+  the parallel-decode stage that turns row groups / record batches
+  into frames.
+
+`streaming.reduce_blocks_stream` and the `io.stream_*` readers are
+rewired on top; `stream_dataset` is the user-facing entry point.
+"""
+
+from .pipeline import (  # noqa: F401
+    PipeStage,
+    pipelined,
+    set_stage_fault_injector,
+)
+from .dataset import (  # noqa: F401
+    ChunkTask,
+    Dataset,
+    IngestStream,
+    discover_shards,
+    stream_dataset,
+)
+
+__all__ = [
+    "ChunkTask",
+    "Dataset",
+    "IngestStream",
+    "PipeStage",
+    "discover_shards",
+    "pipelined",
+    "set_stage_fault_injector",
+    "stream_dataset",
+]

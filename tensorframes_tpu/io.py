@@ -12,6 +12,8 @@ path) with background prefetch overlapping device execution.
 
 from __future__ import annotations
 
+import glob as _glob
+import os
 from typing import Iterator, Optional
 
 from .frame import TensorFrame
@@ -23,7 +25,18 @@ __all__ = [
     "write_parquet",
     "read_parquet",
     "stream_parquet",
+    "stream_dataset",
 ]
+
+
+def _is_multi_path(path) -> bool:
+    """A list/tuple, a directory, or a glob pattern routes to the
+    multi-file dataset pipeline; a single file keeps the lightweight
+    one-handle reader below."""
+    if not isinstance(path, (str, os.PathLike)):
+        return True
+    p = os.fspath(path)
+    return os.path.isdir(p) or _glob.has_magic(p)
 
 
 def write_arrow_ipc(frame: TensorFrame, path: str) -> None:
@@ -75,17 +88,38 @@ def read_arrow_ipc(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
 
 
 def stream_arrow_ipc(
-    path: str, batches_per_frame: int = 1
+    path, batches_per_frame: int = 1
 ) -> Iterator[TensorFrame]:
     """Lazily yield one frame per ``batches_per_frame`` record batches —
     bounded host memory regardless of file size. Feed directly to
     `reduce_blocks_stream`, whose prefetch thread overlaps the next
-    read with the current device reduction."""
+    read with the current device reduction.
+
+    ``path`` may also be a directory, a glob, or a sequence of paths —
+    a multi-file dataset, routed through the pipelined ingest engine
+    (`stream_dataset`: deterministic shard order, parallel decode).
+
+    The file handle closes via try/finally the moment the stream ends,
+    errors, or the consumer ``close()``s / abandons the generator (the
+    pipeline runtime closes its source deterministically) — never
+    "whenever GC gets to it", which on a pipeline thread could be long
+    after the stream died."""
+    if _is_multi_path(path):
+        return stream_dataset(
+            path, format="ipc", chunk_groups=batches_per_frame
+        )
+    return _stream_arrow_ipc_single(os.fspath(path), batches_per_frame)
+
+
+def _stream_arrow_ipc_single(
+    path: str, batches_per_frame: int
+) -> Iterator[TensorFrame]:
     import pyarrow as pa
 
     if batches_per_frame < 1:
         raise ValueError("batches_per_frame must be >= 1")
-    with pa.OSFile(path, "rb") as source:
+    source = pa.OSFile(path, "rb")
+    try:
         reader = pa.ipc.open_file(source)
         n = reader.num_record_batches
         for start in range(0, n, batches_per_frame):
@@ -94,6 +128,8 @@ def stream_arrow_ipc(
                 for bi in range(start, min(start + batches_per_frame, n))
             ]
             yield TensorFrame.from_arrow(pa.Table.from_batches(group))
+    finally:
+        source.close()
 
 
 # ---------------------------------------------------------------------------
@@ -140,17 +176,53 @@ def read_parquet(path: str, num_blocks: Optional[int] = None) -> TensorFrame:
 
 
 def stream_parquet(
-    path: str, row_groups_per_frame: int = 1
+    path, row_groups_per_frame: int = 1
 ) -> Iterator[TensorFrame]:
     """Lazily yield one frame per ``row_groups_per_frame`` row groups —
     bounded host memory regardless of file size, the Parquet twin of
-    `stream_arrow_ipc` (feed to `reduce_blocks_stream`)."""
+    `stream_arrow_ipc` (feed to `reduce_blocks_stream`).
+
+    Multi-file datasets (directory / glob / sequence of paths) route
+    through the pipelined ingest engine (`stream_dataset`); the file
+    handle closes via try/finally on end, error, or consumer abandon —
+    see `stream_arrow_ipc`."""
+    if _is_multi_path(path):
+        return stream_dataset(
+            path, format="parquet", chunk_groups=row_groups_per_frame
+        )
+    return _stream_parquet_single(os.fspath(path), row_groups_per_frame)
+
+
+def _stream_parquet_single(
+    path: str, row_groups_per_frame: int
+) -> Iterator[TensorFrame]:
     import pyarrow.parquet as pq
 
     if row_groups_per_frame < 1:
         raise ValueError("row_groups_per_frame must be >= 1")
-    with pq.ParquetFile(path) as pf:
+    pf = pq.ParquetFile(path)
+    try:
         n = pf.num_row_groups
         for start in range(0, n, row_groups_per_frame):
             idx = list(range(start, min(start + row_groups_per_frame, n)))
             yield TensorFrame.from_arrow(pf.read_row_groups(idx))
+    finally:
+        pf.close()
+
+
+def stream_dataset(paths, format: str = "auto", chunk_groups: int = 1,
+                   decode_workers: Optional[int] = None,
+                   depth: Optional[int] = None):
+    """Stream a MULTI-FILE dataset (directory / glob / explicit list of
+    Parquet or Arrow IPC shards) as frames through the pipelined ingest
+    engine: deterministic shard discovery -> parallel decode
+    (``decode_workers`` threads) -> in-order delivery under the shared
+    buffering budget. Feed to `reduce_blocks_stream`, which composes
+    its H2D transfer stage and the multi-device rotation into the same
+    stage graph. See `ingest.dataset.stream_dataset`."""
+    from .ingest.dataset import stream_dataset as _sd
+
+    return _sd(
+        paths, format=format, chunk_groups=chunk_groups,
+        decode_workers=decode_workers, depth=depth,
+    )

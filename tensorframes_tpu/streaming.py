@@ -19,7 +19,7 @@ from .graph.analysis import analyze_graph
 from .graph.ir import base_name as _base
 from .runtime.executor import Executor
 from .utils import telemetry as _telemetry
-from .utils.profiling import record
+from .utils.profiling import count as record_count, record
 
 # late-bound: api imports this module, so helper lookups resolve at
 # call time through the module object (same pattern as parallel/verbs)
@@ -29,138 +29,29 @@ from .api import Fetches  # noqa: E402,F401  (annotations; api is mid-init
 # but Fetches is defined before this module loads)
 
 
-def _prefetch_iter(it, depth: int = 1, stage=None):
-    """Pull ``it`` on a daemon thread, ``depth`` items ahead. The consumer
-    (device execution) and the producer (chunk synthesis / host IO) then
-    overlap — the streaming analogue of Spark's pipelined partition fetch.
+def _prefetch_iter(it, depth=None, stage=None):
+    """Pull ``it`` on a daemon thread, ``depth`` items ahead (default
+    ``config.stream_prefetch_depth``). The consumer (device execution)
+    and the producer (chunk synthesis / host IO) then overlap — the
+    streaming analogue of Spark's pipelined partition fetch.
 
-    ``stage`` (optional) is a per-item transform run on a SECOND
+    ``stage`` (optional) is a per-item transform run on ANOTHER
     pipeline thread between producer and consumer — the device-transfer
     stage: when it issues `jax.device_put` for chunk k+1, that H2D copy
     proceeds under chunk k's compute, double-buffering transfer against
     execution end to end. A stage failure propagates to the consumer
-    like a producer failure. The ``depth`` budget is SHARED across both
-    pipeline queues (raw queue shrinks to 1 when a stage runs), so
-    adding the stage keeps peak buffered chunks at ~depth+3 — streams
-    sized to the documented bound do not silently double their memory."""
-    import queue
-    import threading
+    like a producer failure (stamped with chunk index + stage name).
 
-    _END = object()
-    cancelled = threading.Event()
+    Since ISSUE 7 this is a thin wrapper over the generic stage-graph
+    runtime (`ingest.pipeline.pipelined`), which owns the shared
+    buffering budget, per-stage telemetry, classified fault retries and
+    cancellation; `reduce_blocks_stream` composes richer graphs
+    (parallel decode of multi-file datasets) through the same runtime.
+    """
+    from .ingest.pipeline import PipeStage, pipelined
 
-    def _make_put(q):
-        def _put(msg) -> bool:
-            # Bounded put that gives up when the consumer abandoned the
-            # generator — otherwise the pipeline threads would block
-            # forever on the full queue, pinning buffered chunks in
-            # memory.
-            while not cancelled.is_set():
-                try:
-                    q.put(msg, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        return _put
-
-    # one buffering budget for the whole pipeline: with a stage, the
-    # raw queue holds a single handoff item and the staged queue gets
-    # the full depth
-    q_raw: "queue.Queue" = queue.Queue(
-        maxsize=1 if stage is not None else max(1, depth)
-    )
-    put_raw = _make_put(q_raw)
-
-    def _stamp(e: BaseException, idx: int, stage_name: str) -> BaseException:
-        # chunk-index context for pipeline failures: the consumer sees
-        # WHICH chunk (and which pipeline stage) died without the
-        # exception type changing — `tfs_chunk_index` rides as an
-        # attribute and the re-raise site logs it
-        if getattr(e, "tfs_chunk_index", None) is None:
-            try:
-                e.tfs_chunk_index = idx
-                e.tfs_pipeline_stage = stage_name
-            except Exception:
-                pass  # extension exceptions without a __dict__
-        return e
-
-    def producer():
-        idx = 0
-        try:
-            for item in it:
-                if not put_raw(("item", item)):
-                    return
-                idx += 1
-        except BaseException as e:  # noqa: BLE001 — re-raised on consumer side
-            put_raw(("error", _stamp(e, idx, "producer")))
-            return
-        put_raw(("end", _END))
-
-    threading.Thread(target=producer, daemon=True).start()
-
-    if stage is None:
-        q_out = q_raw
-    else:
-        q_out = queue.Queue(maxsize=max(1, depth))
-        put_out = _make_put(q_out)
-
-        def stager():
-            idx = 0
-            while not cancelled.is_set():
-                try:  # bounded get: exit promptly on consumer abandon
-                    kind, payload = q_raw.get(timeout=0.1)
-                except queue.Empty:
-                    continue
-                if kind == "item":
-                    try:
-                        payload = stage(payload)
-                    except BaseException as e:  # noqa: BLE001 — consumer side
-                        put_out(("error", _stamp(e, idx, "transfer-stage")))
-                        return
-                    idx += 1
-                if not put_out((kind, payload)):
-                    return
-                if kind != "item":
-                    return
-
-        threading.Thread(target=stager, daemon=True).start()
-
-    from .utils import telemetry as _tele
-
-    try:
-        while True:
-            if _tele.enabled():
-                # queue depth at each consume: how far ahead the
-                # producer/transfer stages are running (0 = the consumer
-                # is starved, depth = the pipeline is saturated)
-                _tele.gauge_set("stream_queue_depth", q_out.qsize())
-            kind, payload = q_out.get()
-            if kind == "error":
-                idx = getattr(payload, "tfs_chunk_index", None)
-                if idx is not None:
-                    from .utils.log import get_logger
-
-                    get_logger("streaming").warning(
-                        "stream pipeline failed at chunk %d (%s stage): "
-                        "%s: %s",
-                        idx,
-                        getattr(payload, "tfs_pipeline_stage", "?"),
-                        type(payload).__name__, payload,
-                    )
-                raise payload
-            if kind == "end":
-                return
-            yield payload
-    finally:
-        cancelled.set()
-        for q in (q_out, q_raw):
-            while not q.empty():  # release buffered chunks promptly
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
+    stages = [] if stage is None else [PipeStage("transfer-stage", stage)]
+    return pipelined(it, stages, depth=depth)
 
 
 def reduce_blocks_stream(
@@ -310,10 +201,28 @@ def reduce_blocks_stream(
         # rotate. An EXPLICIT one-device list stays: rotation over one
         # device IS the documented pin (every chunk targets it).
         sched_devs = None
-    stage = _to_device if local else None
+    # Compose ONE stage graph for the whole ingest path. A plain
+    # iterator of frames keeps the classic producer -> transfer shape;
+    # an `IngestStream` (multi-file dataset from `stream_dataset` /
+    # multi-path io readers) contributes its discovery source and
+    # parallel-decode stage, so discovery, decode, H2D transfer,
+    # compute and combine all overlap under one shared buffering
+    # budget instead of two chained pipelines.
+    from .ingest.dataset import IngestStream
+    from .ingest.pipeline import PipeStage, pipelined
+
+    if isinstance(frames, IngestStream) and not frames.started:
+        source, pipe_stages = frames.source_and_stages()
+        pipe_depth = frames.depth
+    else:
+        # plain iterator — or an IngestStream someone already pulled
+        # from, whose running pipeline must be consumed, not rebuilt
+        source, pipe_stages, pipe_depth = frames, [], None
+    if local:
+        pipe_stages.append(PipeStage("transfer-stage", _to_device))
 
     partials: List[Dict] = []
-    for f in _prefetch_iter(frames, stage=stage):
+    for f in pipelined(source, pipe_stages, depth=pipe_depth):
         chunk_dev = _chunk_device(consume_idx)
         nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
         if nrows == 0:
@@ -367,10 +276,26 @@ def reduce_blocks_stream(
             # streams cost O(#chunks) host RAM — the documented bound —
             # not device HBM. The newest partial stays on device, so the
             # current dispatch still overlaps the next chunk's
-            # production/transfer.
-            partials[-2] = {
-                k: np.asarray(v) for k, v in partials[-2].items()
-            }
+            # production/transfer. The spill is a real D2H sync and is
+            # accounted as one (host_sync span/counter + d2h bytes) —
+            # diagnostics previously under-reported D2H traffic on long
+            # unfoldable streams.
+            spill_src = partials[-2]
+            if any(not isinstance(v, np.ndarray) for v in spill_src.values()):
+                with _telemetry.span(
+                    "reduce_blocks_stream.spill", kind="host_sync",
+                    chunk=len(partials) - 2,
+                ):
+                    spilled = {
+                        k: np.asarray(v) for k, v in spill_src.items()
+                    }
+                record_count("host_sync")
+                if _telemetry.enabled():
+                    _telemetry.histogram_observe(
+                        "d2h_bytes",
+                        float(sum(v.nbytes for v in spilled.values())),
+                    )
+                partials[-2] = spilled
     if not partials:
         raise ValueError(
             "reduce_blocks_stream over an empty iterator (or every chunk "

@@ -48,7 +48,10 @@ from typing import Iterable, Optional, Sequence
 from ..runtime import executor as _exmod
 from ..runtime import faults as _rt_faults
 
-__all__ = ["InjectedFault", "FaultPlan", "inject"]
+__all__ = [
+    "InjectedFault", "FaultPlan", "inject",
+    "StageFaultPlan", "inject_stage",
+]
 
 _PRIME = 1_000_003
 
@@ -220,3 +223,110 @@ def inject(
         yield plan
     finally:
         _exmod.set_fault_injector(None)
+
+
+# ---------------------------------------------------------------------------
+# ingest-stage injection (the pipeline seam, mirroring the executor's)
+# ---------------------------------------------------------------------------
+
+
+class StageFaultPlan:
+    """One active ingest-stage injection campaign. Ordinals count HOOK
+    INVOCATIONS on the targeted stage (not chunk indices): a retried
+    chunk is a new ordinal, exactly like the executor seam — so a
+    transient ``nth`` fault fires once and its retry draws fresh."""
+
+    def __init__(
+        self,
+        stage: Optional[str] = "decode",
+        rate: float = 0.0,
+        seed: int = 0,
+        fault: str = _rt_faults.TRANSIENT,
+        nth: Optional[Iterable[int]] = None,
+        max_faults: Optional[int] = None,
+    ):
+        if fault not in (
+            _rt_faults.TRANSIENT, _rt_faults.RESOURCE,
+            _rt_faults.DETERMINISTIC,
+        ):
+            raise ValueError(f"unknown fault class {fault!r}")
+        self.stage = stage
+        self.rate = float(rate)
+        self.seed = int(seed)
+        self.fault = fault
+        self.nth = None if nth is None else {int(n) for n in nth}
+        self.max_faults = max_faults
+        self._lock = threading.Lock()
+        self._ordinal = 0
+        self.injected = 0
+        self.attempts = 0
+        self.faulted_ordinals: list = []
+
+    def _hook(self, stage_name: str, item) -> None:
+        if self.stage is not None and stage_name != self.stage:
+            return
+        with self._lock:
+            ordinal = self._ordinal
+            self._ordinal += 1
+            self.attempts += 1
+            if self.max_faults is not None and self.injected >= self.max_faults:
+                return
+        if self.nth is not None:
+            fire = ordinal in self.nth
+        elif self.rate > 0.0:
+            fire = (
+                random.Random(self.seed * _PRIME + ordinal).random()
+                < self.rate
+            )
+        else:
+            fire = False
+        if not fire:
+            return
+        with self._lock:
+            self.injected += 1
+            self.faulted_ordinals.append(ordinal)
+        tag = {
+            _rt_faults.TRANSIENT: "UNAVAILABLE: injected shard-read failure",
+            _rt_faults.RESOURCE:
+                "RESOURCE_EXHAUSTED: injected decode out of memory",
+            _rt_faults.DETERMINISTIC: "injected corrupt shard",
+        }[self.fault]
+        raise InjectedFault(
+            f"{tag} (stage={stage_name!r}, attempt #{ordinal})",
+            self.fault, ordinal, stage_name,
+        )
+
+
+@contextlib.contextmanager
+def inject_stage(
+    stage: Optional[str] = "decode",
+    rate: float = 0.0,
+    seed: int = 0,
+    fault: str = _rt_faults.TRANSIENT,
+    nth: Optional[Sequence[int]] = None,
+    max_faults: Optional[int] = None,
+):
+    """Install a `StageFaultPlan` on the ingest pipeline's stage seam
+    (`ingest.pipeline.set_stage_fault_injector`) for the enclosed
+    block: every attempt of the targeted stage (``stage=None`` = all
+    stages) draws a seeded verdict and may raise a classified
+    `InjectedFault` — transient faults exercise the per-chunk retry
+    path, deterministic ones the fail-fast path with shard/chunk
+    context. One plan at a time; composes freely with the executor-seam
+    `inject` (separate hooks, separate ordinal streams)."""
+    from ..ingest import pipeline as _pipe
+
+    if _pipe._stage_fault_injector is not None:
+        raise RuntimeError(
+            "an ingest-stage fault-injection plan is already active; "
+            "nest-free by design (ordinal determinism)"
+        )
+    plan = StageFaultPlan(
+        stage=stage, rate=rate, seed=seed, fault=fault, nth=nth,
+        max_faults=max_faults,
+    )
+    _pipe.set_stage_fault_injector(plan._hook)
+    try:
+        yield plan
+    finally:
+        _pipe.set_stage_fault_injector(None)

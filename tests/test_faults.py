@@ -678,6 +678,61 @@ class TestPrefetchFailures:
             tfs.reduce_blocks_stream(g, chunks())
         assert getattr(ei.value, "tfs_chunk_index", None) == 1
 
+    def test_injected_transient_decode_fault_retries(self, tmp_path):
+        """ISSUE 7: the parallel-decode stage routes through the same
+        classified-retry layer as block dispatch — a transient shard
+        read fails, retries in place, and the stream completes with the
+        ledger showing the retry."""
+        from tensorframes_tpu import io as tio
+
+        data = np.arange(48.0, dtype=np.float32)
+        for i in range(3):
+            tio.write_parquet(
+                tfs.TensorFrame.from_dict(
+                    {"x": data[i * 16:(i + 1) * 16]}, num_blocks=2
+                ),
+                str(tmp_path / f"s{i}.parquet"),
+            )
+        df0 = tfs.TensorFrame.from_dict({"x": data[:1]})
+        with config.override(**FAST_RETRY):
+            with chaos.inject_stage(stage="decode", nth=[0]) as plan:
+                total = tfs.reduce_blocks_stream(
+                    _sum_graph(df0),
+                    tio.stream_dataset(str(tmp_path), decode_workers=2),
+                )
+        assert plan.injected == 1
+        np.testing.assert_allclose(float(total), data.sum(), rtol=1e-6)
+        assert rtf.ledger_snapshot()["retries"] >= 1
+
+    def test_injected_deterministic_decode_fault_fails_fast(self, tmp_path):
+        """A corrupt shard is deterministic: exactly one decode attempt,
+        and the surfaced error names the shard file and chunk index."""
+        from tensorframes_tpu import io as tio
+
+        for i in range(2):
+            tio.write_parquet(
+                tfs.TensorFrame.from_dict(
+                    {"x": np.arange(8.0, dtype=np.float32)}
+                ),
+                str(tmp_path / f"s{i}.parquet"),
+            )
+        df0 = tfs.TensorFrame.from_dict(
+            {"x": np.arange(1.0, dtype=np.float32)}
+        )
+        with chaos.inject_stage(
+            stage="decode", nth=[1], fault="deterministic"
+        ) as plan:
+            with pytest.raises(chaos.InjectedFault) as ei:
+                tfs.reduce_blocks_stream(
+                    _sum_graph(df0),
+                    tio.stream_dataset(str(tmp_path), decode_workers=2),
+                )
+        assert plan.injected == 1
+        assert plan.attempts <= 2  # no retry burn on the corrupt shard
+        assert ei.value.tfs_pipeline_stage == "decode"
+        assert str(ei.value.tfs_shard_path).endswith(".parquet")
+        assert rtf.ledger_snapshot()["failfast"] >= 1
+
 
 # ---------------------------------------------------------------------------
 # ledger / stats surfacing

@@ -14,15 +14,20 @@ import json
 import os
 from typing import Optional
 
-# Datasheet peaks per device kind (chip-level) — the ONE copy; bench.py
-# and the MFU harness both read it, so a new device kind lands
-# everywhere at once.
-DEVICE_PEAKS = {
-    # TPU v5e: 819 GB/s HBM BW, 197 TFLOP/s bf16 (f32 data runs the MXU
-    # in bf16 passes under precision=DEFAULT, so bf16 peak is the bound)
-    "TPU v5 lite": {"hbm_bytes_s": 819e9, "matmul_flops_s": 197e12},
-    "TPU v5": {"hbm_bytes_s": 2765e9, "matmul_flops_s": 459e12},
-}
+# Datasheet peaks per device kind (chip-level) — now owned by the
+# runtime cost ledger (`runtime.costmodel.DEVICE_PEAKS`), which
+# `tfs.diagnostics()` joins against; re-exported here LAZILY (PEP 562)
+# so bench.py and older callers keep one import path without
+# `import benchmarks._util` (scaled/emit users) paying the full
+# framework import at module load.
+
+
+def __getattr__(name):
+    if name == "DEVICE_PEAKS":
+        from tensorframes_tpu.runtime.costmodel import DEVICE_PEAKS
+
+        return DEVICE_PEAKS
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def scaled(env: str, default: int) -> int:
@@ -35,9 +40,12 @@ def run_block_mfu(batch: int, hidden: int, layers: int, iters: int) -> dict:
     implementation shared by `benchmarks/mfu_bench.py` and the repo-root
     `bench.py` capture: block-level bf16 MLP through `map_blocks`, sized
     by the caller to saturate the MXU; MFU = XLA-counted flops x calls /
-    wall / datasheet peak — flops come from `api.cost_analysis` on the
-    exact compiled program, not an analytic guess. The full-shape
-    warm-up keeps compilation out of the timed region.
+    wall / datasheet peak. Flops come from the runtime COST LEDGER
+    (`runtime.costmodel`) — the warm-up dispatch already captured the
+    exact compiled program's cost analysis, so this harness no longer
+    re-lowers the graph (falls back to `api.cost_analysis` only when
+    the ledger is disabled). The full-shape warm-up keeps compilation
+    out of the timed region.
 
     Returns {achieved_flops_s, flops_per_call, mfu (None off-table),
     device_kind}."""
@@ -50,8 +58,8 @@ def run_block_mfu(batch: int, hidden: int, layers: int, iters: int) -> dict:
 
     import tensorframes_tpu as tfs
     from tensorframes_tpu import config as tfs_config
-    from tensorframes_tpu.api import cost_analysis
     from tensorframes_tpu.models import MLP
+    from tensorframes_tpu.runtime import costmodel
 
     model = MLP([hidden] * (layers + 1), seed=0, param_dtype=jnp.bfloat16)
     graph = model.scoring_graph("features", block=True)
@@ -60,22 +68,29 @@ def run_block_mfu(batch: int, hidden: int, layers: int, iters: int) -> dict:
     )
     df = tfs.TensorFrame.from_dict({"features": data}).to_device()
     with tfs_config.override(matmul_precision="default"):
-        ca = cost_analysis(graph, df)
         jax.block_until_ready(
             tfs.map_blocks(graph, df, trim=True).column("probs").values
         )
+        entry = costmodel.program_costs().get(graph.fingerprint())
+        flops_per_call = entry["flops_per_exec"] if entry else None
+        if flops_per_call is None:
+            # ledger off (TFS_COST_LEDGER=0) or capture unavailable:
+            # pay the one-off re-lowering the ledger normally replaces
+            from tensorframes_tpu.api import cost_analysis
+
+            flops_per_call = cost_analysis(graph, df)["flops"]
         t0 = time.perf_counter()
         for _ in range(iters):
             out = tfs.map_blocks(graph, df, trim=True)
         jax.block_until_ready(out.column("probs").values)
         dt = time.perf_counter() - t0
-    achieved = ca["flops"] * iters / dt
+    achieved = flops_per_call * iters / dt
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
-    peak = DEVICE_PEAKS.get(kind, {}).get("matmul_flops_s")
+    peak = costmodel.DEVICE_PEAKS.get(kind, {}).get("matmul_flops_s")
     return {
         "achieved_flops_s": achieved,
-        "flops_per_call": ca["flops"],
+        "flops_per_call": flops_per_call,
         "mfu": (achieved / peak) if peak else None,
         "device_kind": kind,
     }

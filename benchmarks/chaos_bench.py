@@ -144,7 +144,7 @@ def main():
         assert sum(ds.values()) > 0
     emit("chaos results identical to fault-free run", 1, "bool")
 
-    # ---- single-block OOM -> split-retry ------------------------------
+    # ---- single-block OOM -> split-retry + forensics ------------------
     rtf.reset_ledger()
     device_health().reset()
     with chaos.inject(nth=[1], fault="resource") as plan:
@@ -154,6 +154,20 @@ def main():
     np.testing.assert_allclose(got2["sum"], ref["sum"], rtol=1e-5)
     assert led["splits"] >= 1, "injected OOM did not split-retry"
     emit("chaos OOM split-retry completed correctly", led["splits"], "splits")
+
+    # forensic snapshot: the OOM must be an EXPLAINABLE event — program
+    # named, modeled footprint attached, split decision recorded — in
+    # executor_stats()["faults"]["forensics"]
+    snaps = executor_stats()["faults"]["forensics"]
+    assert snaps, "injected RESOURCE_EXHAUSTED left no forensic snapshot"
+    snap = snaps[0]
+    assert snap["program"], "forensic snapshot does not name the program"
+    assert snap["decision"].startswith("split:"), snap["decision"]
+    assert snap["modeled"] and snap["modeled"]["footprint_bytes"], (
+        "forensic snapshot carries no modeled footprint"
+    )
+    assert snap["devices"], "forensic snapshot has no per-device memory"
+    emit("chaos OOM forensic snapshots", len(snaps), "snapshots")
 
     device_health().reset()
     rtf.reset_ledger()

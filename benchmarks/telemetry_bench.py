@@ -12,6 +12,10 @@ Two contracts, both asserted:
    window includes real compiles) exports a non-empty, parseable Chrome
    trace containing ≥ 1 compile span and ≥ 1 per-block dispatch span,
    with the dispatch spans nested under their verb.
+3. **Cost ledger live**: the overhead contract above is measured with
+   the always-on cost ledger (`runtime.costmodel`) capturing — and the
+   traced run must have populated it (modeled flops for the chain's
+   programs, joined into `diagnostics(format="json")`).
 
 Sizes: TELE_ROWS (1_000_000), TELE_BLOCKS (8), TELE_ITERS (5).
 """
@@ -117,6 +121,28 @@ def main():
     emit("trace export compile spans", len(compiles), "events")
     emit("trace export dispatch spans", len(dispatches), "events")
     os.remove(path)
+
+    # --- cost ledger: the overhead numbers above were measured with it
+    # live; prove it actually captured the chain's programs
+    from tensorframes_tpu.runtime import costmodel
+
+    assert costmodel.enabled(), "cost ledger must be ON by default"
+    costs = costmodel.program_costs()
+    with_flops = [
+        fp for fp, c in costs.items() if c["total_flops"] is not None
+    ]
+    assert with_flops, (
+        "traced run captured no program cost — the ledger is not wired "
+        "into the compile path"
+    )
+    diag = tfs.diagnostics(format="json")
+    ledger_rows = {
+        r["program"]: r for r in diag["cost"]["programs"] if r["execs"]
+    }
+    assert ledger_rows, "diagnostics(json) carries no cost-ledger rows"
+    for fp, row in ledger_rows.items():
+        assert row["footprint_bytes"], f"program {fp}: no modeled footprint"
+    emit("cost ledger programs captured", len(with_flops), "programs")
 
 
 if __name__ == "__main__":

@@ -58,6 +58,12 @@ from . import utils
 from .utils import telemetry
 from .utils.telemetry import diagnostics
 
+# Live telemetry endpoint auto-start: serve /metrics /healthz
+# /diagnostics /trace IFF the operator set TFS_TELEMETRY_PORT /
+# config.telemetry_port (off by default — `maybe_serve` is a no-op
+# then, and never raises).
+telemetry.maybe_serve()
+
 __all__ = [
     "Column",
     "TensorFrame",

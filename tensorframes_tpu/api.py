@@ -367,13 +367,31 @@ def _dispatch_reduce_block(
                 sched=sched, index=bi,
             )
         except Exception as e:
-            if (
-                _flt.classify(e) != _flt.RESOURCE
-                or split_combs is None
-                or not _flt.split_allowed(hi_ - lo_, depth)
-            ):
+            if _flt.classify(e) != _flt.RESOURCE:
+                raise
+            bucket = (
+                _sp.bucket_for(hi_ - lo_) if mask_plan is not None else None
+            )
+            if split_combs is None:
+                # OOM on an unclassifiable reduce: no monoid recipe to
+                # combine halves — re-raise the original error, with
+                # the forensic snapshot explaining WHY no split ran
+                _flt.record_oom(
+                    what_verb, fp, hi_ - lo_, depth,
+                    "reraise:unclassifiable-reduce", e, bucket=bucket,
+                )
+                raise
+            if not _flt.split_allowed(hi_ - lo_, depth):
+                _flt.record_oom(
+                    what_verb, fp, hi_ - lo_, depth,
+                    "reraise:split-depth-exhausted", e, bucket=bucket,
+                )
                 raise
             mid = (lo_ + hi_) // 2
+            _flt.record_oom(
+                what_verb, fp, hi_ - lo_, depth,
+                f"split:[{lo_}:{mid})+[{mid}:{hi_})", e, bucket=bucket,
+            )
             _flt.note_split(what_verb)
             left = run(lo_, mid, depth + 1)
             right = run(mid, hi_, depth + 1)
@@ -928,13 +946,28 @@ def map_blocks(
                 sched=sched, index=bi,
             )
         except Exception as e:
-            if (
-                _flt.classify(e) != _flt.RESOURCE
-                or not rowwise
-                or not _flt.split_allowed(hi_ - lo_, depth)
-            ):
+            if _flt.classify(e) != _flt.RESOURCE:
+                raise
+            if not rowwise:
+                _flt.record_oom(
+                    "map_blocks", fp, hi_ - lo_, depth,
+                    "reraise:not-row-local", e,
+                    bucket=bucket if bucketed else None,
+                )
+                raise
+            if not _flt.split_allowed(hi_ - lo_, depth):
+                _flt.record_oom(
+                    "map_blocks", fp, hi_ - lo_, depth,
+                    "reraise:split-depth-exhausted", e,
+                    bucket=bucket if bucketed else None,
+                )
                 raise
             mid = (lo_ + hi_) // 2
+            _flt.record_oom(
+                "map_blocks", fp, hi_ - lo_, depth,
+                f"split:[{lo_}:{mid})+[{mid}:{hi_})", e,
+                bucket=bucket if bucketed else None,
+            )
             _flt.note_split("map_blocks")
             left = _dispatch_rows(bi, lo_, mid, depth + 1)
             right = _dispatch_rows(bi, mid, hi_, depth + 1)
@@ -1153,11 +1186,19 @@ def map_rows(
             try:
                 return _thunk_outs(_thunk, bi, lo_, hi_)
             except Exception as e:
-                if _flt.classify(e) != _flt.RESOURCE or not _flt.split_allowed(
-                    hi_ - lo_, depth
-                ):
+                if _flt.classify(e) != _flt.RESOURCE:
+                    raise
+                if not _flt.split_allowed(hi_ - lo_, depth):
+                    _flt.record_oom(
+                        "map_rows", fp, hi_ - lo_, depth,
+                        "reraise:split-depth-exhausted", e,
+                    )
                     raise
                 mid = (lo_ + hi_) // 2
+                _flt.record_oom(
+                    "map_rows", fp, hi_ - lo_, depth,
+                    f"split:[{lo_}:{mid})+[{mid}:{hi_})", e,
+                )
                 _flt.note_split("map_rows")
                 left = _dispatch_rows(bi, lo_, mid, depth + 1)
                 right = _dispatch_rows(bi, mid, hi_, depth + 1)

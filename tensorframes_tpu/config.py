@@ -184,6 +184,46 @@ class Config:
     # O(N) no matter how long the process runs. Applied on
     # `telemetry.reset()` (the ring is rebuilt at the current value).
     telemetry_ring_entries: int = 8192
+    # Live telemetry endpoint (`utils.telemetry_http`): when non-zero,
+    # `tfs.telemetry.serve()` (and the import-time auto-start) binds an
+    # HTTP server on this port serving /metrics (Prometheus text),
+    # /healthz (device-health JSON), /diagnostics (JSON) and /trace
+    # (Chrome trace JSON). 0 (default) = off; `serve(port=0)` picks an
+    # ephemeral port explicitly. Binds 127.0.0.1 unless
+    # telemetry_host says otherwise — the endpoint exposes program
+    # fingerprints and device state and has NO auth, so exposing it
+    # beyond localhost is a deliberate operator decision. Env override
+    # TFS_TELEMETRY_PORT seeds the initial value (set it and the
+    # package import starts the server).
+    telemetry_port: int = dataclasses.field(
+        default_factory=lambda: int(
+            __import__("os").environ.get("TFS_TELEMETRY_PORT", "0") or "0"
+        )
+    )
+    telemetry_host: str = "127.0.0.1"
+    # Always-on cost/memory ledger (`runtime.costmodel`): every XLA
+    # shape specialization of a cached program captures the compiler's
+    # modeled flops / HBM bytes (from the lowered module's cost
+    # analysis — no second XLA compile) plus exact argument/output
+    # byte counts, and every dispatch counts against its shape entry,
+    # so `tfs.diagnostics()` can report achieved-vs-peak fractions per
+    # program fingerprint without ever re-lowering a graph. Capture
+    # cost is paid only at compile events; steady-state dispatches pay
+    # one dict update. Independent of `telemetry` (the ledger is on
+    # even when span recording is off). Env override TFS_COST_LEDGER
+    # ("0" disables) seeds the initial value.
+    cost_ledger: bool = dataclasses.field(
+        default_factory=lambda: __import__("os").environ.get(
+            "TFS_COST_LEDGER", "1"
+        ).lower() not in ("0", "false", "off")
+    )
+    # Deep memory capture: additionally compile the lowered module at
+    # capture time to read `memory_analysis()` (temp/scratch bytes —
+    # the part of the footprint avals cannot model). DOUBLES the XLA
+    # compile cost of every new program shape, so it is opt-in; with it
+    # off the modeled footprint is argument + output bytes and
+    # `temp_bytes` reads honest None.
+    cost_ledger_memory: bool = False
     # Fault-tolerant dispatch (`runtime.faults`): every block execution
     # is a pure function of (compiled executable, block arrays) — the
     # property the reference leaned on for Spark task retry — so a

@@ -152,12 +152,24 @@ def _close_source(it) -> None:
             pass  # releasing a half-consumed reader must never mask errors
 
 
-def _run_stage_fn(stage: PipeStage, scope, ordinal: int, item):
+def _run_stage_fn(
+    stage: PipeStage, scope, ordinal: int, item, parent: Optional[int] = None
+):
     """One stage invocation under classified fault handling: transient
     errors retry in place (deterministic backoff, per-chunk attempt cap
     + per-stage budget from ``scope``); everything else surfaces after
     one attempt. Escaping exceptions are stamped with chunk / stage /
-    stage-declared context."""
+    stage-declared context.
+
+    Span attribution: pipeline stages run on WORKER threads, where the
+    telemetry contextvars do not flow — a naive span here would record
+    an orphan root disconnected from the verb consuming the stream.
+    Each successful invocation instead records an already-timed
+    ``stage`` span with an EXPLICIT parent (the consumer-side span id
+    captured by `pipelined` at first pull) plus a ``stage`` label, so
+    the exported Chrome trace nests decode/transfer work under the
+    verb with no orphan parent ids (asserted in tests)."""
+    from ..utils import telemetry as _tele
 
     def attempt():
         hook = _stage_fault_injector
@@ -166,9 +178,15 @@ def _run_stage_fn(stage: PipeStage, scope, ordinal: int, item):
         return stage.fn(item)
 
     try:
-        return scope.dispatch(
+        t0 = time.perf_counter()
+        out = scope.dispatch(
             attempt, what=f"ingest.{stage.name}[chunk {ordinal}]"
         )
+        _tele.add_event(
+            f"ingest.{stage.name}", "stage", t0, time.perf_counter(),
+            parent_id=parent, stage=stage.name, chunk=ordinal,
+        )
+        return out
     except BaseException as e:  # noqa: BLE001 — re-raised at the consumer
         extra = None
         if stage.context is not None:
@@ -198,12 +216,44 @@ def _fault_scope(stage_name: str):
 # ---------------------------------------------------------------------------
 
 
+class _PipelineRoot:
+    """The pipeline's virtual root span: an id reserved up front (so
+    stage spans on WORKER threads can name their parent before the
+    parent region closes) and recorded as an already-timed ``stage``
+    span when the pipeline ends — under the span that was current at
+    first pull when there was one. Guarantees the exported trace never
+    carries an orphan parent id, whatever thread a stage ran on."""
+
+    __slots__ = ("sid", "parent", "t0")
+
+    def __init__(self):
+        from ..utils import telemetry as _tele
+
+        if _tele.enabled():
+            self.parent = _tele.current_span_id()
+            self.sid = _tele.allocate_span_id()
+            self.t0 = time.perf_counter()
+        else:
+            self.parent = self.sid = self.t0 = None
+
+    def close(self, chunks: int) -> None:
+        if self.sid is None:
+            return
+        from ..utils import telemetry as _tele
+
+        _tele.add_event(
+            "ingest.pipeline", "stage", self.t0, time.perf_counter(),
+            parent_id=self.parent, span_id=self.sid, chunks=chunks,
+        )
+
+
 def _serial_pipeline(source, stages: Sequence[PipeStage]):
     """Every stage inline on the consumer thread — no overlap, but the
     same stage functions, fault classification and error stamping as
     the threaded graph (the honest pipeline-off baseline)."""
     it = iter(source)
     scopes = [_fault_scope(s.name) for s in stages]
+    root = _PipelineRoot()
     ordinal = 0
     try:
         while True:
@@ -215,12 +265,13 @@ def _serial_pipeline(source, stages: Sequence[PipeStage]):
                 raise _stamp(e, ordinal, "producer")
             for stage, scope in zip(stages, scopes):
                 t0 = time.perf_counter()
-                item = _run_stage_fn(stage, scope, ordinal, item)
+                item = _run_stage_fn(stage, scope, ordinal, item, root.sid)
                 _note_stage(stage.name, time.perf_counter() - t0, 0.0)
             ordinal += 1
             yield item
     finally:
         _close_source(it)
+        root.close(ordinal)
 
 
 # ---------------------------------------------------------------------------
@@ -309,7 +360,11 @@ def _start_producer(g: _Graph, source, q_out: "queue.Queue") -> None:
 
 
 def _start_serial_stage(
-    g: _Graph, stage: PipeStage, q_in: "queue.Queue", q_out: "queue.Queue"
+    g: _Graph,
+    stage: PipeStage,
+    q_in: "queue.Queue",
+    q_out: "queue.Queue",
+    parent: Optional[int] = None,
 ) -> None:
     """A single-worker stage: in-order by construction (one thread, one
     bounded in/out queue) — the old transfer-stage shape."""
@@ -334,7 +389,7 @@ def _start_serial_stage(
                 )
             t1 = time.perf_counter()
             try:
-                payload = _run_stage_fn(stage, scope, pos, payload)
+                payload = _run_stage_fn(stage, scope, pos, payload, parent)
             except BaseException as e:  # noqa: BLE001 — consumer side
                 g.put(q_out, (_ERROR, pos, e))
                 return
@@ -366,6 +421,7 @@ def _start_pooled_stage(
     q_in: "queue.Queue",
     q_out: "queue.Queue",
     depth: int,
+    parent: Optional[int] = None,
 ) -> None:
     """A ``workers > 1`` stage: out-of-order execution, in-order
     delivery through a bounded reorder buffer."""
@@ -411,7 +467,10 @@ def _start_pooled_stage(
                     return
             t1 = time.perf_counter()
             try:
-                out = (_ITEM, _run_stage_fn(stage, scope, pos, payload))
+                out = (
+                    _ITEM,
+                    _run_stage_fn(stage, scope, pos, payload, parent),
+                )
             except BaseException as e:  # noqa: BLE001 — consumer side
                 out = (_ERROR, e)
             else:
@@ -491,6 +550,14 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
         return
 
     g = _Graph()
+    # cross-thread span attribution: stage spans recorded on worker
+    # threads parent to the pipeline's virtual root span (contextvars
+    # do not flow into pipeline threads; the root's id is reserved NOW
+    # and its region recorded at shutdown, so no child ever references
+    # a missing parent). The root itself parents to whatever span is
+    # current at first pull — the consuming verb, when there is one.
+    root = _PipelineRoot()
+    parent = root.sid
     # one buffering budget for the whole graph: intermediate handoffs
     # hold a single item (cheap task descriptors may buffer a few more)
     # and the DELIVERY queue gets the full depth — adding stages must
@@ -506,11 +573,12 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
         last = i == len(stages) - 1
         q_out = g.make_queue(depth if last else 1)
         if stage.workers == 1:
-            _start_serial_stage(g, stage, q, q_out)
+            _start_serial_stage(g, stage, q, q_out, parent)
         else:
-            _start_pooled_stage(g, stage, q, q_out, depth)
+            _start_pooled_stage(g, stage, q, q_out, depth, parent)
         q = q_out
 
+    delivered = 0
     try:
         while True:
             t0 = time.perf_counter()
@@ -547,6 +615,8 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
             if kind == _END:
                 return
             _note_stage("compute", 0.0, wait_s)
+            delivered += 1
             yield payload
     finally:
         g.shutdown()
+        root.close(delivered)

@@ -189,14 +189,19 @@ class Executor:
 
         def wrapped(*args, **kwargs):
             from ..utils import telemetry as _tele
+            from . import costmodel as _cm
 
             # jit shape re-specialization attribution: when this call
             # grows the jit cache, the (synchronous) trace+XLA-compile
             # happened inside it — time the call and label the compile
-            # event with the program fingerprint. Only when telemetry is
-            # on: disabled runs pay nothing beyond the storm check below.
+            # event with the program fingerprint. Tracked when telemetry
+            # OR the cost ledger is on (the ledger captures the
+            # compiler's modeled cost at exactly these events); with
+            # both disabled runs pay nothing beyond the storm check
+            # below.
+            ledger = _cm.enabled()
             n0 = None
-            if _tele.enabled():
+            if _tele.enabled() or ledger:
                 try:
                     n0 = sizer()
                 except Exception:
@@ -223,6 +228,14 @@ class Executor:
                     _tele.record_compile(
                         key[1], key[0], t1 - t0, "xla", t0, t1
                     )
+                    if ledger:
+                        # the XLA compile for this shape just happened;
+                        # lowering again here is tracing + HLO cost
+                        # analysis only (no second backend compile) —
+                        # the ONE window where modeled cost is captured
+                        _cm.capture(key, fn, args)
+            if ledger:
+                _cm.note_exec(key, args, out)
             from .. import config as _config
 
             threshold = _config.get().recompile_warn_shapes

@@ -54,6 +54,7 @@ from __future__ import annotations
 import threading
 import time
 import zlib
+from collections import deque as _deque
 from typing import Callable, Dict, Optional, Sequence
 
 from ..utils.log import get_logger
@@ -69,6 +70,8 @@ __all__ = [
     "run_with_retries",
     "combine_split_partials",
     "note_split",
+    "record_oom",
+    "forensics_snapshot",
     "ledger_snapshot",
     "reset_ledger",
     "device_grant",
@@ -218,7 +221,8 @@ def note_split(verb: str) -> None:
 def ledger_snapshot() -> Dict[str, int]:
     """The fault ledger: classified failure counts plus what was done
     about them (retries / splits / device evictions / fail-fasts /
-    grant timeouts). Merged into ``executor_stats()['faults']``."""
+    grant timeouts). Merged into ``executor_stats()['faults']``
+    (which appends the OOM forensic snapshots under ``forensics``)."""
     with _ledger_lock:
         return dict(_ledger)
 
@@ -227,6 +231,78 @@ def reset_ledger() -> None:
     with _ledger_lock:
         for k in list(_ledger):
             _ledger[k] = 0
+        _forensics.clear()
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: what was resident when a dispatch ran out of memory
+# ---------------------------------------------------------------------------
+
+# bounded: OOMs are rare, and a flapping device must not grow an
+# unbounded evidence log — the freshest window is the useful one
+_FORENSICS_MAX = 16
+_forensics: "_deque" = _deque(maxlen=_FORENSICS_MAX)
+
+
+def record_oom(
+    verb: str,
+    program,
+    rows: int,
+    depth: int,
+    decision: str,
+    error: BaseException,
+    bucket: Optional[int] = None,
+) -> None:
+    """Capture a forensic snapshot for one ``resource``-classified
+    dispatch: the failing program, its cost-ledger modeled footprint,
+    the live-buffer / memory_stats state per device AT FAULT TIME, the
+    block's row range + bucket rung, and the split decision
+    (``"split"`` — the runtime is about to halve the range — or a
+    ``"reraise:*"`` reason when splitting is ineligible). Turns a
+    silent degradation event into an explainable one: surfaced in
+    ``executor_stats()['faults']['forensics']`` and rendered by
+    `tfs.diagnostics()`. Never raises — forensics must not worsen the
+    failure it documents."""
+    try:
+        from . import costmodel as _cm
+
+        snap = {
+            "verb": str(verb),
+            "program": str(program),
+            "rows": int(rows),
+            "bucket": int(bucket) if bucket is not None else None,
+            "depth": int(depth),
+            "decision": str(decision),
+            "error": f"{type(error).__name__}: {str(error)[:200]}",
+            "modeled": _cm.program_footprint(program),
+            "devices": _cm.memory_overview(),
+        }
+    except Exception:  # degraded snapshot beats no snapshot
+        snap = {
+            "verb": str(verb),
+            "program": str(program),
+            "rows": int(rows),
+            "bucket": None,
+            "depth": int(depth),
+            "decision": str(decision),
+            "error": type(error).__name__,
+            "modeled": None,
+            "devices": [],
+        }
+    with _ledger_lock:
+        _forensics.append(snap)
+    try:
+        from ..utils import telemetry as _tele
+
+        _tele.counter_inc("oom_forensics", 1.0, verb=str(verb))
+    except Exception:
+        pass
+
+
+def forensics_snapshot() -> list:
+    """The bounded OOM forensic log, oldest first."""
+    with _ledger_lock:
+        return [dict(s) for s in _forensics]
 
 
 # ---------------------------------------------------------------------------

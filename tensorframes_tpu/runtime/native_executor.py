@@ -108,6 +108,16 @@ class NativeExecutor:
         ex._bind_host(host, jax_fallback)
         return ex
 
+    @staticmethod
+    def _ledger_key(label: Optional[Tuple], traceable: Callable) -> Tuple:
+        """The cost ledger's (kind, fingerprint) for a native program:
+        the executor cache key when `cached` routed here, else the
+        function front-end's name (the same fallback labeling
+        `record_compile` uses)."""
+        if label is not None:
+            return label
+        return ("fn", getattr(traceable, "__name__", "<fn>"))
+
     def _native_run(
         self, traceable: Callable, label: Optional[Tuple] = None
     ) -> Callable:
@@ -204,11 +214,33 @@ class NativeExecutor:
                         _t0,
                         _t1,
                     )
+                    # cost ledger: the Lowered is already in hand here,
+                    # so modeled flops/bytes cost one HLO cost analysis
+                    from . import costmodel as _cm
+
+                    if _cm.enabled():
+                        _cm.capture(
+                            self._ledger_key(label, traceable),
+                            None, args, lowered=lowered, phase="native",
+                        )
+            from . import costmodel as _cm
+
             if entry[0] == "jax":
-                return entry[1](*args)
+                out = entry[1](*args)
+                # the opted-in fallback has no Lowered to capture cost
+                # from, but its executions still count — the program
+                # stays visible in the ledger with honest None cost
+                if _cm.enabled():
+                    _cm.note_exec(
+                        self._ledger_key(label, traceable), args, out
+                    )
+                return out
             exe, out_specs, out_tree = entry
             outs = exe(*flat_in, out_specs=out_specs)
-            return jax.tree_util.tree_unflatten(out_tree, outs)
+            out = jax.tree_util.tree_unflatten(out_tree, outs)
+            if _cm.enabled():
+                _cm.note_exec(self._ledger_key(label, traceable), args, out)
+            return out
 
         return run
 

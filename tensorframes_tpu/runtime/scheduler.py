@@ -53,6 +53,7 @@ __all__ = [
     "DeviceHealth",
     "device_health",
     "device_label",
+    "health_overview",
     "plan",
     "resolve",
     "schedule_for",
@@ -203,6 +204,40 @@ def device_health() -> DeviceHealth:
     """The process-wide device-health registry (one circuit breaker per
     device label, shared by every schedule)."""
     return _health
+
+
+def health_overview() -> List[Dict]:
+    """One row per LOCAL device — healthy devices included (unlike
+    `DeviceHealth.table`, which lists only tripped circuits): label,
+    kind, circuit state (``closed`` / ``open`` / ``half-open``),
+    failure count and remaining cooldown. The /healthz endpoint's
+    payload; circuits for devices no longer local (a fallback set after
+    a grant timeout) are appended so they stay visible."""
+    by_label = {row["device"]: row for row in _health.table()}
+    rows: List[Dict] = []
+    try:
+        devices = _local_devices()
+    except Exception:
+        devices = []
+    seen = set()
+    for d in devices:
+        lab = device_label(d)
+        seen.add(lab)
+        tripped = by_label.get(lab)
+        rows.append(
+            {
+                "device": lab,
+                "device_kind": getattr(d, "device_kind", None),
+                "state": tripped["state"] if tripped else "closed",
+                "failures": tripped["failures"] if tripped else 0,
+                "cooldown_s": tripped["cooldown_s"] if tripped else 0.0,
+                "retry_in_s": tripped["retry_in_s"] if tripped else 0.0,
+            }
+        )
+    for lab, tripped in sorted(by_label.items()):
+        if lab not in seen:
+            rows.append({"device_kind": None, **tripped})
+    return rows
 
 
 def device_label(dev) -> str:

@@ -122,11 +122,15 @@ def executor_stats(executor=None) -> Dict[str, int]:
                 out[key] = dict(sorted(ledger.items()))
     # fault ledger (`runtime.faults`): classified failure counts and
     # what the runtime did about them (retries / splits / device
-    # evictions / fail-fasts / grant timeouts). Process-wide — faults
-    # are a dispatch-path property, not an executor-cache one.
+    # evictions / fail-fasts / grant timeouts), plus the bounded OOM
+    # forensic snapshots (program, modeled footprint, split decision,
+    # per-device memory at fault time). Process-wide — faults are a
+    # dispatch-path property, not an executor-cache one.
     from ..runtime import faults as _faults
 
-    out["faults"] = _faults.ledger_snapshot()
+    fl = dict(_faults.ledger_snapshot())
+    fl["forensics"] = _faults.forensics_snapshot()
+    out["faults"] = fl
     return out
 
 

@@ -57,6 +57,7 @@ __all__ = [
     "counter_inc",
     "gauge_set",
     "gauge_register",
+    "gauge_register_multi",
     "histogram_observe",
     "spans",
     "span_aggregates",
@@ -65,6 +66,9 @@ __all__ = [
     "export_chrome_trace",
     "export_prometheus",
     "diagnostics",
+    "diagnostics_data",
+    "serve",
+    "maybe_serve",
     "reset",
     "reset_counters",
 ]
@@ -150,6 +154,9 @@ _CURRENT: "contextvars.ContextVar[Optional[int]]" = contextvars.ContextVar(
 _PROGRAM: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
     "tfs_current_program", default=None
 )
+_VERB: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "tfs_current_verb", default=None
+)
 
 _annotation_cls = None  # resolved once; False = unavailable
 
@@ -197,7 +204,7 @@ class _SpanCtx:
 
     __slots__ = (
         "name", "kind", "attrs", "sid", "parent", "tok", "ann", "t0",
-        "ptok", "program",
+        "ptok", "program", "vtok",
     )
 
     def __init__(self, name, kind, attrs, program=None):
@@ -206,6 +213,7 @@ class _SpanCtx:
         self.attrs = attrs
         self.program = program  # non-None => set the program contextvar
         self.ptok = None
+        self.vtok = None
 
     def __enter__(self):
         self.sid = next(_ids)
@@ -213,6 +221,10 @@ class _SpanCtx:
         self.tok = _CURRENT.set(self.sid)
         if self.program is not None:
             self.ptok = _PROGRAM.set(self.program)
+        if self.kind == "verb":
+            # the verb contextvar: what the cost ledger attributes
+            # per-verb footprint high-water marks to
+            self.vtok = _VERB.set(self.name)
         ann = _annotation(self.name)
         self.ann = ann
         if ann is not None:
@@ -226,6 +238,8 @@ class _SpanCtx:
             self.ann.__exit__(None, None, None)
         if self.ptok is not None:
             _PROGRAM.reset(self.ptok)
+        if self.vtok is not None:
+            _VERB.reset(self.vtok)
         _CURRENT.reset(self.tok)
         attrs = self.attrs
         if et is not None:
@@ -275,18 +289,52 @@ def current_program() -> Optional[str]:
     return _PROGRAM.get()
 
 
+def current_verb() -> Optional[str]:
+    """Name of the enclosing ``verb`` span, if any (the cost ledger's
+    per-verb attribution key)."""
+    return _VERB.get()
+
+
+def current_span_id() -> Optional[int]:
+    """Id of the enclosing span, if any — what cross-thread emitters
+    (ingest pipeline stages) capture on the consumer thread and pass as
+    ``add_event(parent_id=...)`` so worker-thread spans parent to the
+    verb that owns them instead of floating as orphan roots."""
+    return _CURRENT.get()
+
+
+def allocate_span_id() -> int:
+    """Reserve a span id BEFORE its region is recorded: cross-thread
+    emitters (the ingest pipeline) hand the id to worker threads as
+    their explicit parent, then record the parent region itself via
+    `add_event(span_id=...)` when it closes — children never reference
+    an id that will not appear in the export."""
+    return next(_ids)
+
+
 def add_event(
-    name: str, kind: str, t0: float, t1: float, **attrs
+    name: str,
+    kind: str,
+    t0: float,
+    t1: float,
+    parent_id: Optional[int] = None,
+    span_id: Optional[int] = None,
+    **attrs,
 ) -> None:
     """Record an ALREADY-TIMED region retroactively (parented to the
-    current span). Used where the region is only recognized after the
-    fact — e.g. a jit call that turned out to include an XLA shape
-    specialization."""
+    current span, or to an explicit ``parent_id`` — the cross-thread
+    case, where contextvars do not flow). Used where the region is only
+    recognized after the fact — e.g. a jit call that turned out to
+    include an XLA shape specialization, or a pipeline stage running on
+    a worker thread. ``span_id`` records under a previously
+    `allocate_span_id`-reserved id."""
     if not enabled():
         return
     _ring.append(
         Span(
-            next(_ids), _CURRENT.get(), name, kind, t0, t1,
+            span_id if span_id is not None else next(_ids),
+            parent_id if parent_id is not None else _CURRENT.get(),
+            name, kind, t0, t1,
             threading.get_ident(), attrs,
         )
     )
@@ -407,6 +455,12 @@ class MetricsRegistry:
         self._counters: Dict[Tuple[str, LabelItems], float] = {}
         self._gauges: Dict[Tuple[str, LabelItems], float] = {}
         self._gauge_fns: Dict[str, Callable[[], float]] = {}
+        # name -> (label key, fn returning {label value: gauge value}):
+        # one registered callable fanning out to a labeled gauge family
+        # (per-device memory gauges), evaluated only at export
+        self._gauge_multi_fns: Dict[
+            str, Tuple[str, Callable[[], Dict[str, float]]]
+        ] = {}
         self._histograms: Dict[Tuple[str, LabelItems], _Histogram] = {}
 
     # -- counters -------------------------------------------------------
@@ -442,15 +496,31 @@ class MetricsRegistry:
         with self._lock:
             self._gauge_fns[name] = fn
 
+    def gauge_register_multi(
+        self, name: str, label: str, fn: Callable[[], Dict[str, float]]
+    ) -> None:
+        """A registered gauge FAMILY: ``fn()`` returns {label value:
+        gauge value} and exports as ``name{label="..."}`` rows. Like
+        plain registered gauges, survives `reset()`."""
+        with self._lock:
+            self._gauge_multi_fns[name] = (label, fn)
+
     def gauge_values(self) -> Dict[Tuple[str, LabelItems], float]:
         with self._lock:
             out = dict(self._gauges)
             fns = list(self._gauge_fns.items())
+            multi = list(self._gauge_multi_fns.items())
         for name, fn in fns:
             try:
                 out[(name, ())] = float(fn())
             except Exception:
                 pass  # a dead gauge must never break an export
+        for name, (label, fn) in multi:
+            try:
+                for lv, v in fn().items():
+                    out[(name, ((label, str(lv)),))] = float(v)
+            except Exception:
+                pass
         return out
 
     # -- histograms -----------------------------------------------------
@@ -497,6 +567,12 @@ def gauge_set(name: str, value: float, **labels) -> None:
 
 def gauge_register(name: str, fn: Callable[[], float]) -> None:
     _registry.gauge_register(name, fn)
+
+
+def gauge_register_multi(
+    name: str, label: str, fn: Callable[[], Dict[str, float]]
+) -> None:
+    _registry.gauge_register_multi(name, label, fn)
 
 
 def histogram_observe(name: str, value: float, **labels) -> None:
@@ -730,17 +806,65 @@ def _prom_name(name: str) -> str:
     return f"tfs_{safe}"
 
 
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote and newline MUST be escaped or a value like a shard
+    path (``tfs_shard_path`` labels carry arbitrary filesystem paths)
+    silently corrupts the whole scrape."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(labels: LabelItems, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in labels]
+    parts = [f'{k}="{_prom_escape(v)}"' for k, v in labels]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+# HELP text per metric family (exposition format: HELP escapes only
+# backslash and newline). Families without an entry get a generic line
+# — an absent # HELP is a lint error in several Prometheus toolchains.
+_PROM_HELP: Dict[str, str] = {
+    "host_sync": "Device-to-host synchronization points",
+    "fault_retries": "Classified dispatch retries by fault class",
+    "device_evictions": "Failover circuit-breaker device evictions",
+    "block_splits": "OOM-triggered block split-retries by verb",
+    "device_grant_timeouts": "Device acquisitions abandoned by watchdog",
+    "oom_forensics": "Forensic snapshots captured for resource faults",
+    "executor_cache_entries": "Live compiled-program cache entries",
+    "live_device_buffers": "Live jax arrays across all devices",
+    "live_buffer_bytes": "Live jax buffer bytes committed per device",
+    "device_bytes_in_use": "Backend memory_stats bytes_in_use per device",
+    "device_peak_bytes": "Backend memory_stats peak_bytes_in_use per device",
+    "scheduler_queue_depth": "Planned dispatches not yet issued per device",
+    "stream_queue_depth": "Decoded chunks ready ahead of the consumer",
+    "ingest_queue_depth": "Ingest stage input-queue occupancy",
+    "ingest_chunks": "Items through each ingest stage",
+    "ingest_stage_busy_seconds": "Ingest stage busy time",
+    "ingest_stage_wait_seconds": "Ingest stage starved time",
+    "verb_seconds": "Verb call latency",
+    "compile_seconds": "Compile time by program and phase",
+    "block_rows": "Rows per block dispatch",
+    "h2d_bytes": "Host-to-device transfer bytes",
+    "d2h_bytes": "Device-to-host transfer bytes",
+}
+
+
+def _prom_help_text(raw_name: str) -> str:
+    text = _PROM_HELP.get(raw_name, f"tensorframes_tpu metric {raw_name}")
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def export_prometheus() -> str:
     """Counters, gauges and histograms in Prometheus text exposition
     format (histograms with cumulative ``le`` buckets + ``_sum`` /
-    ``_count``), ready for a textfile collector or a /metrics handler."""
+    ``_count``), with ``# HELP`` + ``# TYPE`` headers and escaped label
+    values, ready for a textfile collector or the /metrics handler."""
     lines: List[str] = []
     with _registry._lock:
         counters = list(_registry._counters.items())
@@ -752,22 +876,23 @@ def export_prometheus() -> str:
 
     seen_types: set = set()
 
-    def _type(name: str, t: str) -> None:
+    def _type(name: str, t: str, raw: str) -> None:
         if name not in seen_types:
             seen_types.add(name)
+            lines.append(f"# HELP {name} {_prom_help_text(raw)}")
             lines.append(f"# TYPE {name} {t}")
 
     for (name, labels), v in sorted(counters):
         pn = _prom_name(name)
-        _type(pn, "counter")
+        _type(pn, "counter", name)
         lines.append(f"{pn}{_prom_labels(labels)} {v:g}")
     for (name, labels), v in sorted(gauges.items()):
         pn = _prom_name(name)
-        _type(pn, "gauge")
+        _type(pn, "gauge", name)
         lines.append(f"{pn}{_prom_labels(labels)} {v:g}")
     for (name, labels), (buckets, counts, hsum, hcount) in sorted(hists):
         pn = _prom_name(name)
-        _type(pn, "histogram")
+        _type(pn, "histogram", name)
         cum = 0
         for b, c in zip(buckets, counts[:-1]):
             cum += c
@@ -781,68 +906,219 @@ def export_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
-def diagnostics(executor=None) -> str:
-    """The one-call "where did my wall time go" report: span coverage,
-    per-verb totals, time by phase, the per-program
-    compile/execute/host-sync attribution table (keyed by graph
-    fingerprint — "which program is eating my startup" is the compile
-    column), merged with `executor_stats()` and the recompile-storm
-    signal. Exposed as ``tfs.diagnostics()``."""
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"  # pragma: no cover - loop always returns
+
+
+def _fmt_rate(v, unit: str) -> str:
+    if v is None:
+        return "?"
+    for prefix, scale in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {prefix}{unit}"
+    return f"{v:.2f} {unit}"
+
+
+def _verb_roofline(span_list: List[Span], costs: Dict) -> Dict[str, Dict]:
+    """Per-verb modeled flops/bytes: each dispatch span's program cost
+    (average per exec) attributed to the span's root ``verb`` ancestor.
+    Average-per-exec is exact when a program converged onto one bucket
+    rung; a multi-shape program's split is approximate and documented
+    so."""
+    by_id = {s.span_id: s for s in span_list}
+    out: Dict[str, Dict] = {}
+    for s in span_list:
+        if s.kind != "dispatch":
+            continue
+        prog = s.attrs.get("program")
+        c = costs.get(str(prog)) if prog else None
+        if not c or not c["execs"]:
+            continue
+        node, hops = s, 0
+        verb = None
+        while node is not None and hops < 64:
+            if node.kind == "verb":
+                verb = node.name
+                break
+            node = by_id.get(node.parent_id)
+            hops += 1
+        if verb is None:
+            continue
+        v = out.setdefault(verb, {"flops": 0.0, "bytes": 0.0})
+        if c["total_flops"] is not None:
+            v["flops"] += c["total_flops"] / c["execs"]
+        if c["total_bytes_accessed"] is not None:
+            v["bytes"] += c["total_bytes_accessed"] / c["execs"]
+    return out
+
+
+def diagnostics_data(executor=None) -> Dict:
+    """The machine-readable diagnostics payload (what
+    ``tfs.diagnostics(format="json")`` and the /diagnostics endpoint
+    serve): span aggregates, the cost-ledger roofline join, per-verb
+    footprint peaks, per-device memory, device health, the fault ledger
+    with OOM forensic snapshots, executor stats and the recompile-storm
+    signal. Every value is JSON-serializable; sections that fail to
+    collect carry an ``error`` string instead of raising."""
     from .inspection import executor_stats
 
-    agg = span_aggregates()
+    ss = spans()
+    agg = span_aggregates(ss)
+    data: Dict = {
+        "telemetry_enabled": enabled(),
+        "window": {
+            k: agg[k]
+            for k in ("window", "covered", "coverage", "roots", "spans",
+                      "dropped")
+        },
+        "verbs": agg["by_verb"],
+        "phases": agg["by_kind"],
+        "devices": agg["by_device"],
+        "programs": agg["by_program"],
+    }
+
+    # cost ledger x span join ------------------------------------------
+    try:
+        from ..runtime import costmodel as _cm
+
+        costs = _cm.program_costs()
+        data["cost"] = {
+            "enabled": _cm.enabled(),
+            "peaks": _cm.device_peaks(),
+            "programs": _cm.roofline(agg["by_program"]),
+            "verb_peaks": _cm.verb_peaks(),
+            "verb_roofline": _verb_roofline(ss, costs),
+        }
+    except Exception as e:
+        data["cost"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # per-device memory -------------------------------------------------
+    try:
+        from ..runtime import costmodel as _cm
+
+        data["memory"] = _cm.memory_overview()
+    except Exception as e:
+        data["memory"] = [{"error": f"{type(e).__name__}: {e}"}]
+
+    # fault tolerance: device health + ledger + forensics ---------------
+    try:
+        from ..runtime import faults as _faults
+        from ..runtime.scheduler import device_health
+
+        data["health"] = device_health().table()
+        data["faults"] = _faults.ledger_snapshot()
+        data["forensics"] = _faults.forensics_snapshot()
+    except Exception as e:
+        data["faults_error"] = f"{type(e).__name__}: {e}"
+
+    # executor + recompile-storm signal ---------------------------------
+    try:
+        es = dict(executor_stats(executor))
+        if isinstance(es.get("faults"), dict):
+            # data["forensics"] above is the one canonical copy — the
+            # executor_stats merge would duplicate every snapshot (each
+            # embedding a per-device memory table) in the payload
+            es["faults"] = {
+                k: v for k, v in es["faults"].items() if k != "forensics"
+            }
+        data["executor"] = es
+        from ..runtime.executor import default_executor
+        from .. import config as _config
+
+        ex = executor if executor is not None else default_executor()
+        per_prog = getattr(ex, "program_shape_compiles", None)
+        threshold = _config.get().recompile_warn_shapes
+        if callable(per_prog):
+            shapes = per_prog()
+            data["recompile"] = {
+                "threshold": threshold,
+                "worst": max(shapes.values()) if shapes else 0,
+                "storming": {
+                    f"{k[0]}/{str(k[1])[:12]}": n
+                    for k, n in shapes.items()
+                    if threshold and n > threshold
+                },
+            }
+    except Exception as e:
+        data["executor_error"] = f"{type(e).__name__}: {e}"
+
+    data["gauges"] = {
+        name + _prom_labels(labels): v
+        for (name, labels), v in sorted(_registry.gauge_values().items())
+    }
+    return data
+
+
+def _render_diagnostics(data: Dict) -> str:
     lines = ["tensorframes-tpu diagnostics", "=" * 28]
-    if not enabled():
+    if not data["telemetry_enabled"]:
         lines.append(
             "telemetry is DISABLED (config.telemetry=False / "
             "TFS_TELEMETRY=0): spans below reflect only what was "
             "recorded while it was on"
         )
+    w = data["window"]
     lines.append(
-        f"window: {agg['window']:.4f}s wall, "
-        f"{agg['coverage'] * 100:.1f}% attributed to {agg['roots']} root "
-        f"span(s) ({agg['spans']} spans buffered, {agg['dropped']} dropped)"
+        f"window: {w['window']:.4f}s wall, "
+        f"{w['coverage'] * 100:.1f}% attributed to {w['roots']} root "
+        f"span(s) ({w['spans']} spans buffered, {w['dropped']} dropped)"
     )
 
-    if agg["by_verb"]:
+    cost = data.get("cost", {})
+    verb_roof = cost.get("verb_roofline", {})
+    if data["verbs"]:
         lines.append("")
         lines.append("verbs:")
         for name, v in sorted(
-            agg["by_verb"].items(), key=lambda kv: -kv[1]["seconds"]
+            data["verbs"].items(), key=lambda kv: -kv[1]["seconds"]
         ):
             rows = f"  rows={int(v['rows'])}" if v["rows"] else ""
+            extra = ""
+            vr = verb_roof.get(name)
+            if vr and v["seconds"] > 0 and (vr["flops"] or vr["bytes"]):
+                extra = (
+                    f"  ~{_fmt_rate(vr['flops'] / v['seconds'], 'FLOP/s')}"
+                    f" ~{_fmt_rate(vr['bytes'] / v['seconds'], 'B/s')}"
+                )
             lines.append(
                 f"  {name:<28} calls={v['calls']:<4} "
-                f"total={v['seconds']:.4f}s{rows}"
+                f"total={v['seconds']:.4f}s{rows}{extra}"
             )
-    if agg["by_kind"]:
+    if data["phases"]:
         lines.append("")
         lines.append("time by phase (span totals; dispatch is async issue"
                      " time, not device occupancy):")
         for kind, k in sorted(
-            agg["by_kind"].items(), key=lambda kv: -kv[1]["seconds"]
+            data["phases"].items(), key=lambda kv: -kv[1]["seconds"]
         ):
             lines.append(
                 f"  {kind:<10} {k['seconds']:.4f}s ({k['count']} span(s))"
             )
-    if agg.get("by_device"):
+    if data.get("devices"):
         lines.append("")
         lines.append(
             "devices (block-scheduler dispatch labels; busy = union of "
             "dispatch-issue spans, not device occupancy):"
         )
-        window = max(agg["window"], 1e-12)
-        for dev, d in sorted(agg["by_device"].items()):
+        window = max(w["window"], 1e-12)
+        for dev, d in sorted(data["devices"].items()):
             lines.append(
                 f"  {dev:<10} dispatches={d['dispatches']:<5} "
                 f"busy={d['busy_s']:.4f}s "
                 f"({min(1.0, d['busy_s'] / window) * 100:.1f}% of window)"
             )
-    if agg["by_program"]:
+    if data["programs"]:
         lines.append("")
         lines.append("programs (by graph fingerprint):")
         for prog, p in sorted(
-            agg["by_program"].items(),
+            data["programs"].items(),
             key=lambda kv: -(kv[1]["compile_s"] + kv[1]["execute_s"]),
         ):
             lines.append(
@@ -852,13 +1128,71 @@ def diagnostics(executor=None) -> str:
                 f"host_sync={p['host_sync_s']:.4f}s"
             )
 
-    # fault tolerance: device health + the fault ledger -----------------
-    try:
-        from ..runtime import faults as _faults
-        from ..runtime.scheduler import device_health
+    # cost ledger: the roofline join ------------------------------------
+    if cost.get("programs"):
+        peaks = cost.get("peaks", {})
+        kind = peaks.get("device_kind")
+        known = peaks.get("matmul_flops_s") or peaks.get("hbm_bytes_s")
+        lines.append("")
+        lines.append(
+            "cost ledger (XLA-modeled, captured at compile; achieved = "
+            "modeled total / attributed execute time"
+            + (
+                f"; peaks for {kind})"
+                if known
+                else f"; no datasheet peak for {kind!r} — fractions "
+                "unknown)"
+            )
+        )
+        for r in cost["programs"]:
+            if not r["execs"] and not r["dispatches"]:
+                continue
+            ffrac = r["flops_frac_of_peak"]
+            hfrac = r["hbm_frac_of_peak"]
+            frac = ""
+            if ffrac is not None or hfrac is not None:
+                frac = (
+                    f"  peak: flops={ffrac * 100:.1f}%"
+                    if ffrac is not None
+                    else "  peak: flops=?"
+                )
+                frac += (
+                    f" hbm={hfrac * 100:.1f}%"
+                    if hfrac is not None
+                    else " hbm=?"
+                )
+            lines.append(
+                f"  {r['program']:<16} execs={r['execs']:<5} "
+                f"flops/exec={_fmt_rate(r['flops_per_exec'], 'FLOP')} "
+                f"hbm/exec={_fmt_bytes(r['bytes_per_exec'])} "
+                f"footprint={_fmt_bytes(r['footprint_bytes'])}"
+                + (
+                    "" if r["temp_known"] else "(+temp?)"
+                )
+                + f" achieved={_fmt_rate(r['achieved_flops_s'], 'FLOP/s')}"
+                f"/{_fmt_rate(r['achieved_hbm_bytes_s'], 'B/s')}"
+                + frac
+            )
+        if cost.get("verb_peaks"):
+            lines.append(
+                "verb footprint high-water (largest modeled single "
+                "dispatch):"
+            )
+            for verb, pk in sorted(cost["verb_peaks"].items()):
+                lines.append(
+                    f"  {verb:<28} {_fmt_bytes(pk['bytes'])} "
+                    f"(program {str(pk['program'])[:12]}, "
+                    f"rows={pk['rows']})"
+                )
 
-        health = device_health().table()
-        ledger = _faults.ledger_snapshot()
+    # fault tolerance: device health + the fault ledger -----------------
+    if "faults_error" in data:
+        lines.append(
+            f"fault state unavailable: {data['faults_error']}"
+        )
+    else:
+        health = data.get("health", [])
+        ledger = data.get("faults", {})
         lines.append("")
         if health:
             lines.append(
@@ -879,51 +1213,119 @@ def diagnostics(executor=None) -> str:
                 "faults: "
                 + " ".join(f"{k}={v}" for k, v in sorted(ledger.items()))
             )
-    except Exception as e:  # diagnostics must never raise
-        lines.append(f"fault state unavailable: {type(e).__name__}: {e}")
+        for snap in data.get("forensics", []):
+            modeled = snap.get("modeled") or {}
+            lines.append(
+                f"  oom[{snap.get('verb')}] program "
+                f"{str(snap.get('program'))[:12]} rows={snap.get('rows')} "
+                f"depth={snap.get('depth')} -> {snap.get('decision')}; "
+                "modeled footprint "
+                f"{_fmt_bytes(modeled.get('footprint_bytes'))}"
+            )
+
+    # per-device memory -------------------------------------------------
+    mem = [m for m in data.get("memory", []) if "error" not in m]
+    if mem:
+        lines.append("")
+        lines.append(
+            "device memory (live jax buffers; bytes_in_use/peak from "
+            "backend memory_stats, '?' where unreported):"
+        )
+        for m in mem:
+            lines.append(
+                f"  {m['device']:<10} live={_fmt_bytes(m['live_buffer_bytes'])}"
+                f" ({m['live_buffers']} buffer(s)) "
+                f"in_use={_fmt_bytes(m['bytes_in_use'])} "
+                f"peak={_fmt_bytes(m['peak_bytes_in_use'])}"
+            )
 
     # executor + recompile-storm signal ---------------------------------
-    try:
-        es = executor_stats(executor)
+    if "executor_error" in data:
+        lines.append(
+            f"executor stats unavailable: {data['executor_error']}"
+        )
+    else:
+        es = data.get("executor", {})
         lines.append("")
         lines.append(
             "executor: "
             + " ".join(f"{k}={v}" for k, v in sorted(es.items()))
         )
-        from ..runtime.executor import default_executor
-        from .. import config as _config
-
-        ex = executor if executor is not None else default_executor()
-        per_prog = getattr(ex, "program_shape_compiles", None)
-        threshold = _config.get().recompile_warn_shapes
-        if callable(per_prog):
-            shapes = per_prog()
-            worst = max(shapes.values()) if shapes else 0
-            storming = {
-                k: n for k, n in shapes.items() if threshold and n > threshold
-            }
-            if storming:
+        rc = data.get("recompile")
+        if rc is not None:
+            if rc["storming"]:
                 lines.append(
-                    f"recompile storm: {len(storming)} program(s) over "
-                    f"recompile_warn_shapes={threshold}:"
+                    f"recompile storm: {len(rc['storming'])} program(s) "
+                    f"over recompile_warn_shapes={rc['threshold']}:"
                 )
-                for key, n in sorted(storming.items(), key=lambda kv: -kv[1]):
-                    lines.append(
-                        f"  {key[0]}/{str(key[1])[:12]}: {n} compiled shapes"
-                    )
+                for key, n in sorted(
+                    rc["storming"].items(), key=lambda kv: -kv[1]
+                ):
+                    lines.append(f"  {key}: {n} compiled shapes")
             else:
                 lines.append(
-                    f"recompile storm: none (max {worst} shape(s)/program, "
-                    f"threshold {threshold})"
+                    f"recompile storm: none (max {rc['worst']} "
+                    f"shape(s)/program, threshold {rc['threshold']})"
                 )
-    except Exception as e:  # diagnostics must never raise
-        lines.append(f"executor stats unavailable: {type(e).__name__}: {e}")
 
-    gauges = _registry.gauge_values()
-    if gauges:
+    if data["gauges"]:
         lines.append("")
         lines.append("gauges:")
-        for (name, labels), v in sorted(gauges.items()):
-            lab = _prom_labels(labels)
-            lines.append(f"  {name}{lab} = {v:g}")
+        for name, v in data["gauges"].items():
+            lines.append(f"  {name} = {v:g}")
     return "\n".join(lines)
+
+
+def serve(port: Optional[int] = None, host: Optional[str] = None):
+    """Start the live telemetry HTTP endpoint (`utils.telemetry_http`):
+    ``/metrics`` (Prometheus text), ``/healthz`` (device-health JSON),
+    ``/diagnostics`` (JSON) and ``/trace`` (Chrome trace JSON) on a
+    daemon thread. ``port`` defaults to ``config.telemetry_port``
+    (``TFS_TELEMETRY_PORT``); pass ``port=0`` for an ephemeral port.
+    Binds ``config.telemetry_host`` (127.0.0.1 by default — the
+    endpoint has no auth). Returns the `TelemetryServer` handle
+    (``.port`` / ``.url`` / ``.close()``)."""
+    from . import telemetry_http as _http
+
+    return _http.serve(port=port, host=host)
+
+
+def maybe_serve():
+    """Import-time auto-start: serve IFF ``config.telemetry_port`` is
+    non-zero (i.e. the operator set TFS_TELEMETRY_PORT). Never raises —
+    a busy port logs a warning instead of breaking the import."""
+    from .. import config as _config
+
+    if not getattr(_config.get(), "telemetry_port", 0):
+        return None
+    try:
+        return serve()
+    except Exception as e:
+        from .log import get_logger
+
+        get_logger("telemetry").warning(
+            "telemetry endpoint auto-start failed (TFS_TELEMETRY_PORT/"
+            "config.telemetry_port): %s: %s", type(e).__name__, e,
+        )
+        return None
+
+
+def diagnostics(executor=None, format: str = "text"):
+    """The one-call "where did my wall time go" report: span coverage,
+    per-verb totals, time by phase, the per-program
+    compile/execute/host-sync attribution table (keyed by graph
+    fingerprint), the cost-ledger roofline (modeled flops / HBM bytes /
+    footprint and achieved-vs-peak fractions per program), per-device
+    memory, OOM forensics, merged with `executor_stats()` and the
+    recompile-storm signal. ``format="text"`` (default) renders the
+    human table; ``format="json"`` returns the machine-readable dict
+    (`diagnostics_data`) so benches and CI consume structured data
+    instead of scraping text. Exposed as ``tfs.diagnostics()``."""
+    if format not in ("text", "json"):
+        raise ValueError(
+            f"diagnostics format={format!r} is not one of 'text' | 'json'"
+        )
+    data = diagnostics_data(executor)
+    if format == "json":
+        return data
+    return _render_diagnostics(data)

@@ -1,0 +1,201 @@
+"""Live telemetry endpoint: stdlib HTTP server over the observability
+surfaces.
+
+The registries this serves already exist (`utils.telemetry` spans +
+metrics, `runtime.scheduler` device health, `runtime.faults` ledger,
+`runtime.costmodel` ledger); this module only binds them to a scrape
+port — the piece the ROADMAP's multi-tenant serving runtime names as
+its autoscaling signal source. Four routes:
+
+- ``/metrics`` — Prometheus text exposition (`export_prometheus`),
+  content type ``text/plain; version=0.0.4``.
+- ``/healthz`` — JSON device-health overview (`scheduler
+  .health_overview`): 200 always (liveness), with ``degraded: true``
+  when any failover circuit is open — readiness-style consumers key on
+  the body, not the code.
+- ``/diagnostics`` — the `diagnostics_data` JSON payload.
+- ``/trace`` — the span ring as Chrome trace JSON (load in Perfetto).
+
+Concurrency: `ThreadingHTTPServer` (one thread per in-flight scrape)
+over registries that already snapshot under their own locks, so eight
+concurrent scrapers see consistent, never-torn exports while verbs
+dispatch — regression-tested. The server thread is a daemon: it never
+blocks interpreter exit.
+
+Security: binds ``config.telemetry_host`` = 127.0.0.1 by default. The
+payloads expose program fingerprints, file-path labels and device
+state, and there is NO authentication — exposing the port beyond
+localhost is a deliberate operator decision (front it with a real
+reverse proxy if you must).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+__all__ = ["TelemetryServer", "serve", "active_server"]
+
+_lock = threading.Lock()
+_server: Optional["TelemetryServer"] = None
+
+
+def _json_default(o):
+    item = getattr(o, "item", None)
+    if callable(item):
+        try:
+            return item()
+        except Exception:
+            pass
+    if isinstance(o, (set, frozenset, tuple)):
+        return list(o)
+    return str(o)
+
+
+def _healthz_payload() -> dict:
+    from ..runtime.scheduler import health_overview
+
+    rows = health_overview()
+    degraded = any(r.get("state") not in (None, "closed") for r in rows)
+    return {
+        "status": "degraded" if degraded else "ok",
+        "degraded": degraded,
+        "devices": rows,
+    }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # scrapes are frequent; default per-request stderr logging is noise
+    def log_message(self, fmt, *args):  # noqa: D102 - stdlib signature
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj, default=_json_default).encode()
+        self._send(code, body, "application/json")
+
+    def do_GET(self):  # noqa: N802 - stdlib name
+        from . import telemetry as _tele
+
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(
+                    200,
+                    _tele.export_prometheus().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/healthz":
+                self._send_json(_healthz_payload())
+            elif path == "/diagnostics":
+                self._send_json(_tele.diagnostics_data())
+            elif path == "/trace":
+                self._send_json(_tele.export_chrome_trace())
+            elif path == "/":
+                self._send_json(
+                    {
+                        "service": "tensorframes_tpu telemetry",
+                        "routes": [
+                            "/metrics", "/healthz", "/diagnostics",
+                            "/trace",
+                        ],
+                    }
+                )
+            else:
+                self._send_json({"error": f"no route {path!r}"}, code=404)
+        except Exception as e:  # a scrape must never kill the server
+            try:
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, code=500
+                )
+            except Exception:
+                pass  # client hung up mid-error
+
+
+class TelemetryServer:
+    """Handle to one running endpoint: ``.port`` (resolved — useful with
+    ``port=0``), ``.url``, ``.close()``. Closing joins the serve thread
+    and frees the port synchronously."""
+
+    def __init__(self, host: str, port: int):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            daemon=True,
+            name="tfs-telemetry-http",
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def running(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        global _server
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        with _lock:
+            if _server is self:
+                _server = None
+
+
+def active_server() -> Optional[TelemetryServer]:
+    """The process-wide endpoint, if one is serving."""
+    with _lock:
+        return _server
+
+
+def serve(
+    port: Optional[int] = None, host: Optional[str] = None
+) -> TelemetryServer:
+    """Start the process-wide endpoint (one per process — a second call
+    while one is serving returns the existing handle when no explicit
+    conflicting port was asked for, and raises otherwise). ``port``
+    defaults to ``config.telemetry_port``; 0 binds an ephemeral port.
+    """
+    from .. import config as _config
+    from .log import get_logger
+
+    cfg = _config.get()
+    if port is None:
+        port = int(getattr(cfg, "telemetry_port", 0))
+        if not port:
+            raise ValueError(
+                "telemetry.serve(): no port given and "
+                "config.telemetry_port is 0 (off); pass serve(port=...) "
+                "or set TFS_TELEMETRY_PORT"
+            )
+    if host is None:
+        host = str(getattr(cfg, "telemetry_host", "127.0.0.1"))
+    global _server
+    with _lock:
+        if _server is not None and _server.running:
+            if port in (0, _server.port):
+                return _server
+            raise RuntimeError(
+                f"telemetry endpoint already serving on port "
+                f"{_server.port}; close() it before binding {port}"
+            )
+        srv = TelemetryServer(host, int(port))
+        _server = srv
+    get_logger("telemetry").info(
+        "telemetry endpoint serving on %s (/metrics /healthz "
+        "/diagnostics /trace)", srv.url,
+    )
+    return srv

@@ -38,10 +38,11 @@ def _reset_telemetry():
     (circuit breakers are process-global) and ledger counts must never
     bleed into the next test's scheduling."""
     yield
-    from tensorframes_tpu.runtime import faults
+    from tensorframes_tpu.runtime import costmodel, faults
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
     telemetry.reset()
     faults.reset_ledger()
     device_health().reset()
+    costmodel.reset()

@@ -409,3 +409,179 @@ class TestExecutorStatsHonesty:
         # 3 repartitions -> 3 distinct block shapes of ONE program
         (key,) = [k for k in per if k[0] == "block"]
         assert per[key] == 3
+
+
+class TestPrometheusExposition:
+    """Exposition-format correctness (ISSUE 8 satellite): escaped label
+    values and # HELP headers."""
+
+    def test_label_value_escaping_round_trip(self):
+        evil = 'a\\b"c\nd'  # backslash, quote, newline — a shard path
+        tele.counter_inc("ingest_chunks", 3.0, tfs_shard_path=evil)
+        text = tele.export_prometheus()
+        line = next(
+            l for l in text.splitlines()
+            if l.startswith("tfs_ingest_chunks{")
+        )
+        # one physical line (the raw newline would split the sample)
+        assert "\n" not in line
+        assert line.endswith(" 3")
+        # parse the label value back per the exposition grammar
+        m = __import__("re").match(
+            r'^tfs_ingest_chunks\{tfs_shard_path="((?:[^"\\]|\\.)*)"\} 3$',
+            line,
+        )
+        assert m, line
+        unescaped = (
+            m.group(1)
+            .replace("\\\\", "\x00")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\x00", "\\")
+        )
+        assert unescaped == evil
+
+    def test_help_lines_accompany_types(self):
+        tele.counter_inc("host_sync", 1.0)
+        tele.histogram_observe("verb_seconds", 0.1, verb="map_blocks")
+        text = tele.export_prometheus()
+        lines = text.splitlines()
+        for i, l in enumerate(lines):
+            if l.startswith("# TYPE "):
+                name = l.split()[2]
+                assert lines[i - 1].startswith(f"# HELP {name} "), (
+                    f"# TYPE {name} without a preceding # HELP"
+                )
+        assert any(
+            l.startswith("# HELP tfs_host_sync ") for l in lines
+        )
+
+
+class TestDiagnosticsFormats:
+    """diagnostics(format=) (ISSUE 8 satellite): structured JSON beside
+    the byte-identical default text rendering."""
+
+    def test_json_is_a_serializable_dict(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(64, dtype=np.float32)}, num_blocks=2
+        )
+        tfs.map_blocks((tfs.block(df, "x") * 2.0).named("y"), df)
+        d = tfs.diagnostics(format="json")
+        assert isinstance(d, dict)
+        json.dumps(d)  # fully serializable, no default= crutch
+        for section in (
+            "telemetry_enabled", "window", "verbs", "phases", "programs",
+            "cost", "memory", "health", "faults", "forensics",
+            "executor", "gauges",
+        ):
+            assert section in d, f"missing section {section!r}"
+        assert d["verbs"]["map_blocks"]["calls"] == 1
+
+    def test_text_rendering_matches_data(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.arange(64, dtype=np.float32)}, num_blocks=2
+        )
+        tfs.map_blocks((tfs.block(df, "x") * 2.0).named("y"), df)
+        default = tfs.diagnostics()
+        explicit = tfs.diagnostics(format="text")
+        assert isinstance(default, str)
+        # same renderer, same sections (wall-clock fields in the window
+        # line differ between calls; compare structure not timings)
+        assert default.splitlines()[0] == explicit.splitlines()[0]
+        assert "verbs:" in default and "executor:" in default
+
+    def test_unknown_format_raises(self):
+        with pytest.raises(ValueError):
+            tfs.diagnostics(format="yaml")
+
+
+class TestCrossThreadSpanAttribution:
+    """Ingest PipeStage worker threads + the scheduler dispatch path
+    (ISSUE 8 satellite): stage spans recorded off-thread must parent to
+    the consuming verb (explicit parent id + stage label) — the
+    exported Chrome trace contains NO orphan parent ids."""
+
+    def test_pipelined_stream_dataset_trace_has_no_orphans(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        from tensorframes_tpu import io as tio
+        from tensorframes_tpu.frame import TensorFrame
+        from tensorframes_tpu.io import stream_dataset
+
+        rng = np.random.RandomState(0)
+        parts = []
+        for i, n in enumerate((300, 200, 250)):
+            x = rng.rand(n).astype(np.float32)
+            parts.append(x)
+            tio.write_parquet(
+                TensorFrame.from_dict({"x": x}, num_blocks=2),
+                str(tmp_path / f"shard-{i:03d}.parquet"),
+            )
+        expected = float(np.concatenate(parts).sum())
+
+        df0 = TensorFrame.from_dict({"x": np.arange(2.0, dtype=np.float32)})
+        g = dsl.reduce_sum(
+            tfs.block(df0, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with config.override(ingest_pipeline=True):
+            total = tfs.reduce_blocks_stream(
+                g, stream_dataset(str(tmp_path), decode_workers=2)
+            )
+        assert abs(float(np.asarray(total)) - expected) < 1e-2
+
+        trace = tele.export_chrome_trace()
+        events = trace["traceEvents"]
+        ids = {e["args"]["span_id"] for e in events}
+        orphans = [
+            e for e in events
+            if e["args"].get("parent_id") is not None
+            and e["args"]["parent_id"] not in ids
+        ]
+        assert not orphans, [
+            (e["name"], e["args"]) for e in orphans
+        ]
+        # stage spans exist, labeled, and are parented (decode runs on
+        # pool workers, transfer on its own thread — neither inherits
+        # contextvars, both must carry the explicit parent)
+        stages = [e for e in events if e["cat"] == "stage"]
+        by_stage = {e["args"].get("stage") for e in stages}
+        assert "decode" in by_stage, by_stage
+        assert "transfer-stage" in by_stage, by_stage
+        off_thread = [
+            e for e in stages
+            if e["args"].get("stage") in ("decode", "transfer-stage")
+        ]
+        assert off_thread
+        for e in off_thread:
+            assert e["args"].get("parent_id") in ids, e["args"]
+
+    def test_serial_pipeline_stages_nest_naturally(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        from tensorframes_tpu import io as tio
+        from tensorframes_tpu.frame import TensorFrame
+        from tensorframes_tpu.io import stream_dataset
+
+        x = np.arange(100, dtype=np.float32)
+        tio.write_parquet(
+            TensorFrame.from_dict({"x": x}, num_blocks=2),
+            str(tmp_path / "shard-000.parquet"),
+        )
+        df0 = TensorFrame.from_dict({"x": np.arange(2.0, dtype=np.float32)})
+        g = dsl.reduce_sum(
+            tfs.block(df0, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with config.override(ingest_pipeline=False):
+            total = tfs.reduce_blocks_stream(
+                g, stream_dataset(str(tmp_path), decode_workers=2)
+            )
+        assert abs(float(np.asarray(total)) - float(x.sum())) < 1e-3
+        events = tele.export_chrome_trace()["traceEvents"]
+        ids = {e["args"]["span_id"] for e in events}
+        stages = [
+            e for e in events
+            if e["cat"] == "stage" and e["args"].get("stage")
+        ]
+        assert any(e["args"].get("stage") == "decode" for e in stages)
+        # every stage-labeled span parents to the pipeline root (which
+        # is itself in the trace — no orphan parent ids)
+        for e in stages:
+            assert e["args"].get("parent_id") in ids

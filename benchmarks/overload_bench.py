@@ -1,0 +1,217 @@
+"""Overload bench: the admission-control / deadline acceptance harness
+(ISSUE 9).
+
+Three contracts, asserted:
+
+1. **Overload sheds, never queue-collapses.** At 2x the configured
+   capacity (``max_concurrent_verbs=1``, two client threads hammering),
+   the admission controller must SHED the excess with typed
+   `OverloadError` (carrying a retry-after hint) — every call returns
+   either a bit-identical result or the typed rejection, all threads
+   finish, and the controller's shed count matches the caught
+   exceptions exactly.
+
+2. **Admitted verbs keep their latency.** p99 of admitted calls under
+   overload must stay within 1.5x of the uncontended p99 (+ a small
+   absolute floor for timer noise at smoke sizes): shedding protects
+   the admitted work instead of letting a queue build and drag every
+   caller down.
+
+3. **A deadline storm leaks nothing.** A burst of verbs wedged by
+   injected hangs and killed by tiny ``timeout_s`` budgets — including
+   a deadlined stream — must leave ZERO extra live threads (pipeline
+   workers wake on the cancel event and exit) and a drained admission
+   gate.
+
+Sizes: OVERLOAD_ROWS (1_000_000), OVERLOAD_BLOCKS (8), OVERLOAD_CALLS
+(12 per thread), OVERLOAD_STORM (6).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def _p99(xs):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), 99.0))
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.runtime import deadline as dl
+    from tensorframes_tpu.testing import faults as chaos
+
+    rows = scaled("OVERLOAD_ROWS", 1_000_000)
+    blocks = scaled("OVERLOAD_BLOCKS", 8)
+    calls = scaled("OVERLOAD_CALLS", 12)
+    storm = scaled("OVERLOAD_STORM", 6)
+
+    rng = np.random.RandomState(0)
+    df = TensorFrame.from_dict(
+        {"x": rng.rand(rows).astype(np.float32)}, num_blocks=blocks
+    ).to_device()
+    fetch = dsl.reduce_sum(
+        tfs.block(df, "x", tf_name="x_input"), axes=[0]
+    ).named("x")
+
+    def one_call():
+        t0 = time.perf_counter()
+        out = float(np.asarray(tfs.reduce_blocks(fetch, df)))
+        return time.perf_counter() - t0, out
+
+    # ---- uncontended reference ---------------------------------------
+    _, ref = one_call()  # warm the compile cache
+    lat0 = []
+    for _ in range(calls):
+        dt, out = one_call()
+        assert out == ref, "uncontended result drifted"
+        lat0.append(dt)
+    p99_un = _p99(lat0)
+    emit("overload_uncontended_p99", p99_un * 1e3, "ms")
+
+    # ---- 2x-capacity overload ----------------------------------------
+    dl.controller().reset()
+    n_threads = 2  # 2x the capacity below
+    ok_lat, ok_out, shed_errs, failures = [], [], [], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_threads)
+
+    def client(i):
+        try:
+            barrier.wait(timeout=30.0)
+            done = 0
+            while done < calls:
+                try:
+                    dt, out = one_call()
+                    with lock:
+                        ok_lat.append(dt)
+                        ok_out.append(out)
+                    done += 1
+                except tfs.OverloadError as e:
+                    with lock:
+                        shed_errs.append(e)
+                    # an honest client: back off by the hint (capped —
+                    # the bench must terminate)
+                    time.sleep(min(e.retry_after_s, 0.02))
+                    done += 1
+        except Exception as e:  # noqa: BLE001 — reported below
+            with lock:
+                failures.append((i, repr(e)))
+
+    with config.override(
+        max_concurrent_verbs=1, admission_queue_limit=0
+    ):
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_threads)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        wall = time.perf_counter() - t0
+    assert not any(t.is_alive() for t in threads), (
+        "queue collapse: client threads wedged under overload"
+    )
+    assert not failures, f"client failures under overload: {failures}"
+    total = n_threads * calls
+    assert len(ok_lat) + len(shed_errs) == total, (
+        f"accounting hole: {len(ok_lat)} ok + {len(shed_errs)} shed "
+        f"!= {total}"
+    )
+    assert shed_errs, (
+        "2x overload against capacity 1 shed nothing — the gate is not "
+        "engaging"
+    )
+    assert all(e.retry_after_s > 0 for e in shed_errs)
+    snap = dl.controller().snapshot()
+    assert snap["shed"] == len(shed_errs), (
+        f"controller shed count {snap['shed']} != caught "
+        f"{len(shed_errs)}"
+    )
+    assert snap["peak_in_flight"] <= 1, snap
+    assert all(o == ref for o in ok_out), (
+        "admitted verbs under overload are not bit-identical"
+    )
+    p99_over = _p99(ok_lat)
+    bound = max(1.5 * p99_un, p99_un + 0.05)
+    assert p99_over <= bound, (
+        f"admitted p99 {p99_over * 1e3:.2f}ms exceeds bound "
+        f"{bound * 1e3:.2f}ms (uncontended {p99_un * 1e3:.2f}ms) — "
+        "shedding is not protecting admitted latency"
+    )
+    emit("overload_admitted_p99", p99_over * 1e3, "ms")
+    emit("overload_p99_ratio", p99_over / max(p99_un, 1e-9), "x")
+    emit("overload_shed", float(len(shed_errs)), "calls")
+    emit("overload_admitted", float(len(ok_lat)), "calls")
+    emit(
+        "overload_throughput",
+        total / wall if wall > 0 else 0.0,
+        "calls/s",
+    )
+
+    # ---- deadline storm: zero leaked threads -------------------------
+    before = {
+        t.ident for t in threading.enumerate() if t.is_alive()
+    }
+    deadline_hits = 0
+    with chaos.inject(rate=1.0, seed=1, fault="hang", delay_s=30.0):
+        for _ in range(storm):
+            try:
+                tfs.reduce_blocks(fetch, df, timeout_s=0.05)
+            except tfs.DeadlineExceeded:
+                deadline_hits += 1
+    # a deadlined STREAM must tear its pipeline down too
+
+    def stalling_chunks():
+        for i in range(10_000):
+            time.sleep(0.02)
+            yield TensorFrame.from_dict(
+                {"x": np.ones(16, dtype=np.float32) * i}
+            )
+
+    try:
+        tfs.reduce_blocks_stream(fetch, stalling_chunks(), timeout_s=0.2)
+    except tfs.DeadlineExceeded:
+        deadline_hits += 1
+    assert deadline_hits == storm + 1, (
+        f"{deadline_hits}/{storm + 1} deadlines fired"
+    )
+    # give cooperative teardown a moment, then require convergence
+    leaked = None
+    end = time.monotonic() + 10.0
+    while time.monotonic() < end:
+        now = {
+            t.ident
+            for t in threading.enumerate()
+            if t.is_alive()
+        }
+        leaked = now - before
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, (
+        f"deadline storm leaked {len(leaked)} thread(s): "
+        f"{[t.name for t in threading.enumerate() if t.ident in leaked]}"
+    )
+    assert dl.controller().in_flight_now() == 0, "stuck admission slot"
+    emit("overload_storm_leaked_threads", float(len(leaked or ())), "threads")
+
+    # and the runtime is healthy afterwards: one clean call
+    _, out = one_call()
+    assert out == ref, "post-storm verb is not bit-identical"
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,10 @@ SMOKE_SIZES = {
     "INGEST_GROUPS": "2",
     "INGEST_GROUP_ROWS": "20000",
     "INGEST_ITERS": "2",
+    "OVERLOAD_ROWS": "100000",
+    "OVERLOAD_BLOCKS": "4",
+    "OVERLOAD_CALLS": "6",
+    "OVERLOAD_STORM": "3",
 }
 
 
@@ -90,6 +94,7 @@ def main():
         "ragged_map_rows_bench",
         "stream_overlap_bench",
         "ingest_bench",
+        "overload_bench",
         # LAST THREE: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them

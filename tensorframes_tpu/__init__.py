@@ -50,6 +50,12 @@ from .api import (
 from .graph import Graph, ShapeHints
 from .graph import builder as dsl
 from .runtime import Executor
+from .runtime.deadline import (
+    Cancelled,
+    DeadlineExceeded,
+    OverloadError,
+    deadline_scope,
+)
 from . import config
 from . import io
 from . import ingest
@@ -99,6 +105,10 @@ __all__ = [
     "ShapeHints",
     "dsl",
     "Executor",
+    "Cancelled",
+    "DeadlineExceeded",
+    "OverloadError",
+    "deadline_scope",
     "telemetry",
     "diagnostics",
 ]

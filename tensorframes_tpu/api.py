@@ -44,6 +44,8 @@ from .graph import builder as dsl
 from .graph.analysis import GraphSummary, ShapeHints, analyze_graph
 from .graph.ir import Graph, base_name, parse_edge
 from .ops.lowering import build_callable
+from .runtime import deadline as _dl
+from .runtime.deadline import deadline_entry as _deadline_entry
 from .runtime.executor import Executor, default_executor
 from .runtime.retry import maybe_check_numerics
 from .schema import Shape
@@ -424,6 +426,9 @@ def _combine_partials(ex, kind, graph, fetch_list, feed_names, build, partials):
             return jax.jit(combine, donate_argnums=0)
         return jax.jit(combine)
 
+    # cooperative deadline boundary: a verb whose budget ran out during
+    # the per-block dispatches must not start the combine
+    _dl.check(kind)
     cfn = ex.cached(kind, graph, fetch_list, feed_names, make)
     from .runtime import faults as _flt
     from .utils import telemetry as _tele
@@ -747,6 +752,7 @@ def _string_passthrough_columns(
 
 
 @_pandas_in_out
+@_deadline_entry("map_blocks")
 def map_blocks(
     fetches: Fetches,
     frame: TensorFrame,
@@ -1036,6 +1042,7 @@ def map_blocks(
 
 
 @_pandas_in_out
+@_deadline_entry("map_rows")
 def map_rows(
     fetches: Fetches,
     frame: TensorFrame,
@@ -1313,6 +1320,7 @@ def _validate_reduce_blocks(
 
 
 @_pandas_in_out
+@_deadline_entry("reduce_blocks")
 def reduce_blocks(
     fetches: Fetches,
     frame: TensorFrame,
@@ -1516,6 +1524,7 @@ def _validate_reduce_rows(summary: GraphSummary, fetch_list: List[str]) -> None:
 
 
 @_pandas_in_out
+@_deadline_entry("reduce_rows")
 def reduce_rows(
     fetches: Fetches,
     frame: TensorFrame,
@@ -1731,6 +1740,7 @@ from .aggregate import (  # noqa: E402
 )
 
 
+@_deadline_entry("aggregate")
 def aggregate(
     fetches: Fetches,
     grouped: GroupedFrame,

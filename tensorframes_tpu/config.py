@@ -266,6 +266,44 @@ class Config:
     # ``devices=`` pins opt out of failover (with a loud warning when a
     # pinned device is circuit-open).
     device_cooldown_s: float = 30.0
+    # Deadline / cancellation (`runtime.deadline`): default time budget
+    # for a TOP-LEVEL verb call when no per-call timeout_s= is given
+    # (0 = unbounded, the library default). The budget is an ABSOLUTE
+    # deadline propagated through a contextvar, so everything a verb
+    # starts (lazy force, stream chunks, combines, backoff sleeps,
+    # ingest stages) shares one clock; expiry raises DeadlineExceeded
+    # (classified deterministic — never burned as a retry). Env
+    # override TFS_DEFAULT_VERB_TIMEOUT_S seeds the initial value.
+    default_verb_timeout_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            __import__("os").environ.get("TFS_DEFAULT_VERB_TIMEOUT_S", "0")
+            or "0"
+        )
+    )
+    # Admission control (`runtime.deadline.AdmissionController`): max
+    # TOP-LEVEL verbs in flight at once (0 = unlimited). Nested verbs
+    # (a stream's per-chunk reduce, a lazy terminal's force) never take
+    # a second slot, so small limits cannot deadlock. Env override
+    # TFS_MAX_CONCURRENT_VERBS seeds the initial value — the serving
+    # lane's knob.
+    max_concurrent_verbs: int = dataclasses.field(
+        default_factory=lambda: int(
+            __import__("os").environ.get("TFS_MAX_CONCURRENT_VERBS", "0")
+            or "0"
+        )
+    )
+    # Bounded admission wait queue: callers beyond the concurrency
+    # limit queue up to this many deep; arrivals at a full queue are
+    # SHED immediately with a typed OverloadError (queue depth +
+    # retry-after hint from the live verb_seconds histogram). 0 = shed
+    # the moment the limit is reached (no queueing).
+    admission_queue_limit: int = 32
+    # Max seconds a queued caller waits for a slot before being shed
+    # (its own deadline still applies and may fire first). 0 = wait
+    # bounded only by the caller's deadline — do not combine 0 with
+    # un-deadlined callers in a service, or a stuck verb strands its
+    # whole queue.
+    admission_wait_timeout_s: float = 30.0
     # Device-grant watchdog (`runtime.faults.device_grant`): when > 0,
     # the scheduler's device acquisition runs under a watchdog thread
     # and falls back to the CPU backend with a loud one-time warning if

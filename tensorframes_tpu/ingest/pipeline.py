@@ -69,7 +69,29 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["PipeStage", "pipelined", "set_stage_fault_injector"]
+__all__ = [
+    "PipeStage",
+    "pipelined",
+    "set_stage_fault_injector",
+    "current_cancel_event",
+]
+
+
+# Per-thread handle to the owning graph's cancel event, set for every
+# pipeline thread at spawn: stage code (and the hang-fault injector)
+# running on WORKER threads — where the deadline contextvar does not
+# flow — can wait on it and wake the moment the graph tears down
+# (consumer abandon, stage error, or deadline expiry).
+_CANCEL_LOCAL = threading.local()
+
+
+def current_cancel_event() -> Optional[threading.Event]:
+    """The cancel event of the pipeline graph owning THIS thread (None
+    off pipeline threads). A long-running stage may poll/wait on it to
+    exit early on teardown; `testing.faults`'s ``fault="hang"``
+    injection sleeps against it so injected wedges never outlive the
+    pipeline."""
+    return getattr(_CANCEL_LOCAL, "event", None)
 
 
 class PipeStage:
@@ -251,12 +273,15 @@ def _serial_pipeline(source, stages: Sequence[PipeStage]):
     """Every stage inline on the consumer thread — no overlap, but the
     same stage functions, fault classification and error stamping as
     the threaded graph (the honest pipeline-off baseline)."""
+    from ..runtime import deadline as _dl
+
     it = iter(source)
     scopes = [_fault_scope(s.name) for s in stages]
     root = _PipelineRoot()
     ordinal = 0
     try:
         while True:
+            _dl.check("ingest.pipeline")
             try:
                 item = next(it)
             except StopIteration:
@@ -286,12 +311,32 @@ _ITEM, _END, _ERROR = "item", "end", "error"
 
 
 class _Graph:
-    """Shared cancellation + bounded-put plumbing for one pipeline run."""
+    """Shared cancellation + bounded-put plumbing for one pipeline run.
 
-    def __init__(self):
+    ``scope`` (a `runtime.deadline.CancelScope`, captured from the
+    CONSUMER's context at first pull) folds the verb's deadline /
+    cancellation into the graph's own teardown signal: every queue
+    poll checks `aborted()`, so a deadline expiry tears the stage
+    graph down with exactly the consumer-abandon guarantees — threads
+    exit, the source closes, bounded queues drain."""
+
+    def __init__(self, scope=None):
         self.cancelled = threading.Event()
+        self.scope = scope
         self.queues: List[queue.Queue] = []
         self.threads: List[threading.Thread] = []
+
+    def aborted(self) -> bool:
+        """Teardown signal: explicit shutdown, consumer-scope cancel,
+        or consumer-deadline expiry."""
+        if self.cancelled.is_set():
+            return True
+        if self.scope is not None and self.scope.should_abort():
+            # latch: waking every poller once beats each of them
+            # re-reading the clock forever
+            self.cancelled.set()
+            return True
+        return False
 
     def make_queue(self, maxsize: int) -> "queue.Queue":
         q = queue.Queue(maxsize=max(1, int(maxsize)))
@@ -300,9 +345,9 @@ class _Graph:
 
     def put(self, q: "queue.Queue", msg) -> bool:
         """Bounded put that gives up when the consumer abandoned the
-        pipeline — a blocked put would otherwise pin buffered chunks
-        (and the thread) forever."""
-        while not self.cancelled.is_set():
+        pipeline (or its deadline expired) — a blocked put would
+        otherwise pin buffered chunks (and the thread) forever."""
+        while not self.aborted():
             try:
                 q.put(msg, timeout=0.1)
                 return True
@@ -312,7 +357,7 @@ class _Graph:
 
     def get(self, q: "queue.Queue"):
         """Bounded get; returns None when cancelled."""
-        while not self.cancelled.is_set():
+        while not self.aborted():
             try:
                 return q.get(timeout=0.1)
             except queue.Empty:
@@ -320,7 +365,14 @@ class _Graph:
         return None
 
     def spawn(self, target, name: str) -> None:
-        t = threading.Thread(target=target, daemon=True, name=name)
+        def run():
+            _CANCEL_LOCAL.event = self.cancelled
+            try:
+                target()
+            finally:
+                _CANCEL_LOCAL.event = None
+
+        t = threading.Thread(target=run, daemon=True, name=name)
         self.threads.append(t)
         t.start()
 
@@ -460,10 +512,10 @@ def _start_pooled_stage(
                 while (
                     pos - st.next_emit >= st.window
                     and not st.done
-                    and not g.cancelled.is_set()
+                    and not g.aborted()
                 ):
                     st.cond.wait(timeout=0.1)
-                if st.done or g.cancelled.is_set():
+                if st.done or g.aborted():
                     return
             t1 = time.perf_counter()
             try:
@@ -485,10 +537,10 @@ def _start_pooled_stage(
                 while (
                     st.next_emit not in st.buffer
                     and st.end_at != st.next_emit
-                    and not g.cancelled.is_set()
+                    and not g.aborted()
                 ):
                     st.cond.wait(timeout=0.1)
-                if g.cancelled.is_set():
+                if g.aborted():
                     st.done = True
                     st.cond.notify_all()
                     return
@@ -538,6 +590,7 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
     ``tfs_pipeline_stage`` (+ stage context) stamped, after which the
     graph shuts down the same way."""
     from .. import config as _config
+    from ..runtime import deadline as _dl
     from ..utils import telemetry as _tele
 
     cfg = _config.get()
@@ -549,7 +602,13 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
         yield from _serial_pipeline(source, stages)
         return
 
-    g = _Graph()
+    # the consumer's deadline/cancel scope (this generator body first
+    # runs at first pull, on the consuming verb's thread): its expiry
+    # becomes the graph's teardown signal — the DEADLINE path gives the
+    # same guarantees as consumer abandonment (threads exit, source
+    # closes, queues drain), and the consumer loop below raises the
+    # typed DeadlineExceeded instead of blocking on the queue forever
+    g = _Graph(scope=_dl.current_scope())
     # cross-thread span attribution: stage spans recorded on worker
     # threads parent to the pipeline's virtual root span (contextvars
     # do not flow into pipeline threads; the root's id is reserved NOW
@@ -590,7 +649,31 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
                 _tele.gauge_set(
                     "ingest_queue_depth", q.qsize(), stage="compute"
                 )
-            kind, pos, payload = q.get()
+            # poll, not block: a wedged stage (slow shard, injected
+            # hang) must not hold the consumer past its deadline — the
+            # check raises DeadlineExceeded/Cancelled and the finally
+            # below tears the graph down like an abandon
+            while True:
+                _dl.check("ingest.pipeline")
+                if g.aborted():
+                    # the scope CAPTURED at first pull died (expired,
+                    # or cancel() on a retained handle from another
+                    # thread) and the stage threads may already have
+                    # torn down without delivering _END — the ambient
+                    # check above cannot see a captured scope, so
+                    # raise its typed error here instead of polling
+                    # an abandoned queue forever
+                    if g.scope is not None:
+                        g.scope.check("ingest.pipeline")
+                    raise _dl.Cancelled(
+                        "ingest pipeline torn down mid-consume"
+                    )
+                try:
+                    msg = q.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    continue
+            kind, pos, payload = msg
             wait_s = time.perf_counter() - t0
             if kind == _ERROR:
                 idx = getattr(payload, "tfs_chunk_index", None)

@@ -51,6 +51,7 @@ import numpy as np
 from .frame import Column, TensorFrame
 from .graph.fuse import splice
 from .graph.ir import Graph, base_name as _base
+from .runtime.deadline import deadline_entry as _deadline_entry
 from .schema import ColumnInfo, FrameInfo, ScalarType
 
 # late-bound: api imports this module at its end; helper lookups resolve
@@ -366,6 +367,7 @@ class LazyFrame:
         """Terminal in effect: forces the pending plan, then runs eagerly."""
         return _api.map_rows(fetches, self.force(), **kw)
 
+    @_deadline_entry("reduce_blocks")
     def reduce_blocks(
         self,
         fetches,
@@ -571,6 +573,7 @@ class LazyFrame:
         return _api.GroupedFrame(self.force(), keys)
 
     # -- terminal actions ----------------------------------------------
+    @_deadline_entry("lazy.force")
     def force(self, executor=None, mesh=None, devices=None) -> TensorFrame:
         """Lower the whole fused plan as ONE XLA program per block (one
         fused shard_map program with a mesh) and return the concrete

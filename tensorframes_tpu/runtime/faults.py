@@ -178,6 +178,7 @@ def classify(exc: BaseException) -> str:
 _LEDGER_KEYS = (
     "transient", "resource", "deterministic",  # classified failures seen
     "retries", "splits", "evictions", "failfast", "grant_timeouts",
+    "deadlines", "shed",  # runtime.deadline: budget expiries + admission sheds
 )
 _ledger_lock = threading.Lock()
 _ledger: Dict[str, int] = {k: 0 for k in _LEDGER_KEYS}
@@ -203,6 +204,19 @@ def note_transient_retry() -> None:
     from ..utils import telemetry as _tele
 
     _tele.counter_inc("fault_retries", 1.0, **{"class": TRANSIENT})
+
+
+def note_deadline() -> None:
+    """Ledger hook for `runtime.deadline`: one verb ran out its time
+    budget (the labeled ``deadline_exceeded{verb=}`` counter is
+    incremented by the scope that raised)."""
+    _note("deadlines")
+
+
+def note_shed() -> None:
+    """Ledger hook for `runtime.deadline`: admission control shed one
+    verb (the ``verbs_shed`` counter is the controller's)."""
+    _note("shed")
 
 
 def note_split(verb: str) -> None:
@@ -373,7 +387,7 @@ class FaultScope:
         what: str = "block",
         sched=None,
         index: Optional[int] = None,
-        sleep: Callable[[float], None] = time.sleep,
+        sleep: Optional[Callable[[float], None]] = None,
     ):
         """Run a zero-arg dispatch ``thunk`` with classified fault
         handling:
@@ -389,13 +403,45 @@ class FaultScope:
           retry lands on the re-placed device. Gives up when the
           per-block attempts or the verb budget run out and re-raises
           the last transient error.
+
+        Every attempt starts with a cooperative deadline/cancel check
+        (`runtime.deadline.check`): a verb past its budget stops
+        issuing dispatches at the next boundary, and the escaping
+        `DeadlineExceeded` is stamped with the schedule's partial-work
+        accounting (``tfs_blocks_issued`` / ``tfs_blocks_unissued``).
+        The default backoff ``sleep`` is the deadline-aware
+        interruptible wait — it wakes on cancellation and CLIPS to the
+        remaining budget, so a timed-out verb never sleeps past its
+        deadline (an explicit ``sleep=`` callable, used by tests,
+        bypasses the clipping but not the per-attempt checks).
         """
         from ..utils import telemetry as _tele
+        from . import deadline as _dl
+
+        def _stamp_partial(e):
+            if sched is not None and getattr(
+                e, "tfs_blocks_issued", None
+            ) is None:
+                prog = getattr(sched, "progress", None)
+                if callable(prog):
+                    try:
+                        p = prog()
+                        e.tfs_blocks_issued = p["issued"]
+                        e.tfs_blocks_unissued = p["unissued"]
+                    except Exception:
+                        pass
+            return e
 
         attempt = 0
         while True:
             try:
+                _dl.check(what)
                 return thunk()
+            except (_dl.DeadlineExceeded, _dl.Cancelled) as e:
+                # counted once at the raising scope (deadline ledger +
+                # deadline_exceeded{verb=}) — not double-booked as a
+                # classified dispatch failure here
+                raise _stamp_partial(e)
             except Exception as e:  # noqa: BLE001 — classified below
                 cls = classify(e)
                 _note(cls)
@@ -428,12 +474,20 @@ class FaultScope:
                     f", evicted device {evicted}" if evicted else "",
                     delay, e,
                 )
-                with _tele.span(
-                    "fault.retry", kind="fault", what=what,
-                    attempt=attempt, device=evicted,
-                    **{"class": TRANSIENT},
-                ):
-                    sleep(delay)
+                try:
+                    with _tele.span(
+                        "fault.retry", kind="fault", what=what,
+                        attempt=attempt, device=evicted,
+                        **{"class": TRANSIENT},
+                    ):
+                        if sleep is not None:
+                            sleep(delay)
+                        else:
+                            _dl.sleep_interruptible(
+                                delay, f"{what} (backoff)"
+                            )
+                except (_dl.DeadlineExceeded, _dl.Cancelled) as de:
+                    raise _stamp_partial(de)
 
 
 def scope(
@@ -452,7 +506,7 @@ def run_with_retries(
     attempts: int = 0,
     what: str = "block",
     verb: Optional[str] = None,
-    sleep: Callable[[float], None] = time.sleep,
+    sleep: Optional[Callable[[float], None]] = None,
 ):
     """Classified drop-in for the old blanket retry: call ``fn(*args)``;
     TRANSIENT errors get up to ``attempts`` extra attempts with
@@ -564,6 +618,27 @@ def device_grant(
         grab = jax.local_devices
     if timeout_s is None:
         timeout_s = _config.get().device_grant_timeout_s
+    # an active verb deadline bounds the grant too (min of the two
+    # budgets): a verb with 0.5s left must not wait a 30s watchdog —
+    # and with the watchdog OFF, the deadline alone arms it, so a
+    # deadlined verb can never wedge at device acquisition
+    from . import deadline as _deadline
+
+    _deadline.check("device_grant")
+    _rem = _deadline.remaining()
+    deadline_clipped = False
+    if _rem is not None and (
+        not timeout_s or timeout_s <= 0 or _rem < timeout_s
+    ):
+        # the DEADLINE, not the watchdog config, bounds this wait: a
+        # timeout here means the verb ran out of budget, NOT that the
+        # backend is wedged — it must surface as DeadlineExceeded and
+        # must never poison the process-wide fallback cache (a healthy
+        # backend that merely initializes slower than one verb's
+        # remaining budget would otherwise degrade every future verb
+        # to CPU forever)
+        timeout_s = _rem
+        deadline_clipped = True
     with _grant_lock:
         if _grant_fallback is not None:
             return list(_grant_fallback)
@@ -595,6 +670,17 @@ def device_grant(
         with _grant_lock:
             _grant_granted = True
         return list(box["devices"])
+
+    if deadline_clipped:
+        # verb budget exhausted while the grant was still in flight:
+        # raise the verb's own typed deadline (check() observes the
+        # now-expired scope) — no warning, no counter, and above all
+        # NO cached fallback. The grab thread parks on its daemon
+        # thread; a later verb with a real budget re-probes cleanly.
+        _deadline.check("device_grant")
+        raise TimeoutError(  # pragma: no cover - clock-skew backstop
+            "device grant outlived the verb deadline"
+        )
 
     # wedged at grant: fall back
     _note("grant_timeouts")

@@ -271,16 +271,24 @@ def _local_devices() -> List:
     import jax
 
     from .. import config as _config
+    from . import deadline as _dl
 
     t = _config.get().device_grant_timeout_s
-    if t and t > 0:
+    if (t and t > 0) or _dl.remaining() is not None:
         # device-grant watchdog: a wedged accelerator backend (stuck at
         # device grant — the shared-TPU failure mode) times out here and
         # the process degrades to the CPU backend with a loud one-time
-        # warning instead of hanging forever
+        # warning instead of hanging forever. An active verb DEADLINE
+        # arms the watchdog too (min of the two budgets, applied inside
+        # device_grant): a deadlined verb can never wedge at grant even
+        # with the config watchdog off.
         from . import faults as _faults
 
-        return list(_faults.device_grant(grab=jax.local_devices, timeout_s=t))
+        return list(
+            _faults.device_grant(
+                grab=jax.local_devices, timeout_s=t if t and t > 0 else None
+            )
+        )
     return list(jax.local_devices())
 
 
@@ -512,6 +520,26 @@ class BlockSchedule:
             return out
 
         return call
+
+    def progress(self) -> Dict[str, int]:
+        """Partial-work accounting: how many planned dispatches have
+        been issued vs not. What a `DeadlineExceeded` escaping a
+        scheduled verb is stamped with (``tfs_blocks_issued`` /
+        ``tfs_blocks_unissued``) — a cancelled verb stops issuing at
+        the next boundary check, and this says exactly how far it
+        got."""
+        with self._lock:
+            planned = sum(1 for s in self.assignment if s is not None)
+            issued = sum(
+                1
+                for i, s in enumerate(self.assignment)
+                if s is not None and self._issued[i]
+            )
+        return {
+            "planned": planned,
+            "issued": issued,
+            "unissued": planned - issued,
+        }
 
     def evict(self, index: int) -> Optional[str]:
         """Failover after a transient failure of item ``index``: open

@@ -54,6 +54,10 @@ def _prefetch_iter(it, depth=None, stage=None):
     return pipelined(it, stages, depth=depth)
 
 
+from .runtime.deadline import deadline_entry as _deadline_entry
+
+
+@_deadline_entry("reduce_blocks_stream")
 def reduce_blocks_stream(
     fetches: Fetches,
     frames,

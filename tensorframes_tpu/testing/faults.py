@@ -43,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import random
 import threading
+import time
 from typing import Iterable, Optional, Sequence
 
 from ..runtime import executor as _exmod
@@ -50,10 +51,45 @@ from ..runtime import faults as _rt_faults
 
 __all__ = [
     "InjectedFault", "FaultPlan", "inject",
-    "StageFaultPlan", "inject_stage",
+    "StageFaultPlan", "inject_stage", "HANG",
 ]
 
 _PRIME = 1_000_003
+
+# the fourth injectable "fault": not an error at all, but a WEDGE — the
+# dispatch (or ingest stage) sleeps ``delay_s`` before proceeding
+# normally, simulating a slow shard / stuck device without a real
+# hang. Deadline paths are testable with it: the sleep is cooperative
+# (it waits on the ambient CancelScope on the dispatch thread, or the
+# pipeline's cancel event on ingest worker threads), so an injected
+# wedge wakes the moment the verb's deadline fires or the pipeline
+# tears down — it can outlive neither.
+HANG = "hang"
+_FAULT_CLASSES = (
+    _rt_faults.TRANSIENT, _rt_faults.RESOURCE, _rt_faults.DETERMINISTIC,
+    HANG,
+)
+
+
+def _hang_sleep(delay_s: float, what: str) -> None:
+    """The cooperative wedge: on an ingest worker thread, wait on the
+    pipeline's cancel event (wakes at teardown); on a verb thread,
+    sleep against the ambient CancelScope — which RAISES the typed
+    `DeadlineExceeded` mid-sleep when the budget expires, exactly like
+    a real wedged dispatch observed at a cooperative boundary. With
+    neither (no scope, no pipeline), a plain sleep."""
+    from ..ingest.pipeline import current_cancel_event
+    from ..runtime import deadline as _dl
+
+    ev = current_cancel_event()
+    if ev is not None:
+        ev.wait(float(delay_s))
+        return
+    scope = _dl.current_scope()
+    if scope is not None:
+        scope.sleep(float(delay_s), what)
+    else:
+        time.sleep(float(delay_s))
 
 
 class InjectedFault(RuntimeError):
@@ -104,15 +140,14 @@ class FaultPlan:
         program: Optional[str] = None,
         device: Optional[str] = None,
         max_faults: Optional[int] = None,
+        delay_s: float = 0.05,
     ):
-        if fault not in (
-            _rt_faults.TRANSIENT, _rt_faults.RESOURCE,
-            _rt_faults.DETERMINISTIC,
-        ):
+        if fault not in _FAULT_CLASSES:
             raise ValueError(f"unknown fault class {fault!r}")
         self.rate = float(rate)
         self.seed = int(seed)
         self.fault = fault
+        self.delay_s = float(delay_s)
         self.nth = None if nth is None else {int(n) for n in nth}
         self.kind = kind
         self.program = program
@@ -170,6 +205,18 @@ class FaultPlan:
                     plan.injected += 1
                     plan.faulted_ordinals.append(ordinal)
                     plan.faulted_devices.append(dev)
+                if plan.fault == HANG:
+                    # a wedge, not an error: sleep cooperatively, then
+                    # run the real dispatch — unless the verb's
+                    # deadline fires mid-sleep (DeadlineExceeded
+                    # surfaces from the scope, like a real stall
+                    # observed at a cooperative boundary)
+                    _hang_sleep(
+                        plan.delay_s,
+                        f"injected hang (dispatch #{ordinal}, "
+                        f"kind={key[0]!r})",
+                    )
+                    return fn(*args, **kwargs)
                 tag = {
                     _rt_faults.TRANSIENT: "UNAVAILABLE: injected device loss",
                     _rt_faults.RESOURCE:
@@ -203,12 +250,19 @@ def inject(
     program: Optional[str] = None,
     device: Optional[str] = None,
     max_faults: Optional[int] = None,
+    delay_s: float = 0.05,
 ):
     """Install a `FaultPlan` on the executor seam for the enclosed
     block; yields the plan (inspect ``plan.injected`` /
     ``plan.dispatches`` / ``plan.faulted_ordinals`` afterwards). One
     plan at a time — nesting raises, because two plans sharing one
-    ordinal counter would silently change each other's draws."""
+    ordinal counter would silently change each other's draws.
+
+    ``fault="hang"`` injects a cooperative WEDGE instead of an error:
+    the selected dispatches sleep ``delay_s`` before proceeding
+    normally (same per-ordinal determinism, same ``nth`` /
+    ``max_faults`` semantics) — the deadline test harness's stand-in
+    for a stuck device or slow shard."""
     if _exmod._fault_injector is not None:
         raise RuntimeError(
             "a fault-injection plan is already active; nest-free by "
@@ -217,6 +271,7 @@ def inject(
     plan = FaultPlan(
         rate=rate, seed=seed, fault=fault, nth=nth, kind=kind,
         program=program, device=device, max_faults=max_faults,
+        delay_s=delay_s,
     )
     _exmod.set_fault_injector(plan._hook)
     try:
@@ -244,16 +299,15 @@ class StageFaultPlan:
         fault: str = _rt_faults.TRANSIENT,
         nth: Optional[Iterable[int]] = None,
         max_faults: Optional[int] = None,
+        delay_s: float = 0.05,
     ):
-        if fault not in (
-            _rt_faults.TRANSIENT, _rt_faults.RESOURCE,
-            _rt_faults.DETERMINISTIC,
-        ):
+        if fault not in _FAULT_CLASSES:
             raise ValueError(f"unknown fault class {fault!r}")
         self.stage = stage
         self.rate = float(rate)
         self.seed = int(seed)
         self.fault = fault
+        self.delay_s = float(delay_s)
         self.nth = None if nth is None else {int(n) for n in nth}
         self.max_faults = max_faults
         self._lock = threading.Lock()
@@ -285,6 +339,17 @@ class StageFaultPlan:
         with self._lock:
             self.injected += 1
             self.faulted_ordinals.append(ordinal)
+        if self.fault == HANG:
+            # a slow stage, not a failed one: wedge cooperatively (on a
+            # pipeline worker this waits on the graph's cancel event,
+            # so teardown — abandon OR deadline — wakes it), then let
+            # the stage run
+            _hang_sleep(
+                self.delay_s,
+                f"injected stage hang (stage={stage_name!r}, "
+                f"attempt #{ordinal})",
+            )
+            return
         tag = {
             _rt_faults.TRANSIENT: "UNAVAILABLE: injected shard-read failure",
             _rt_faults.RESOURCE:
@@ -305,6 +370,7 @@ def inject_stage(
     fault: str = _rt_faults.TRANSIENT,
     nth: Optional[Sequence[int]] = None,
     max_faults: Optional[int] = None,
+    delay_s: float = 0.05,
 ):
     """Install a `StageFaultPlan` on the ingest pipeline's stage seam
     (`ingest.pipeline.set_stage_fault_injector`) for the enclosed
@@ -312,7 +378,10 @@ def inject_stage(
     stages) draws a seeded verdict and may raise a classified
     `InjectedFault` — transient faults exercise the per-chunk retry
     path, deterministic ones the fail-fast path with shard/chunk
-    context. One plan at a time; composes freely with the executor-seam
+    context, and ``fault="hang"`` wedges the stage for ``delay_s``
+    (cooperatively: the sleep wakes at pipeline teardown) before
+    letting it proceed — the deadline-mid-stream test's slow shard.
+    One plan at a time; composes freely with the executor-seam
     `inject` (separate hooks, separate ordinal streams)."""
     from ..ingest import pipeline as _pipe
 
@@ -323,7 +392,7 @@ def inject_stage(
         )
     plan = StageFaultPlan(
         stage=stage, rate=rate, seed=seed, fault=fault, nth=nth,
-        max_faults=max_faults,
+        max_faults=max_faults, delay_s=delay_s,
     )
     _pipe.set_stage_fault_injector(plan._hook)
     try:

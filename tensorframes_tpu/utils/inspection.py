@@ -131,6 +131,12 @@ def executor_stats(executor=None) -> Dict[str, int]:
     fl = dict(_faults.ledger_snapshot())
     fl["forensics"] = _faults.forensics_snapshot()
     out["faults"] = fl
+    # admission/overload state (`runtime.deadline`): in-flight vs
+    # limit, live queue depth, cumulative admitted/shed — process-wide
+    # like the fault ledger (admission gates verb entry, not a cache).
+    from ..runtime import deadline as _deadline
+
+    out["admission"] = _deadline.controller().snapshot()
     return out
 
 

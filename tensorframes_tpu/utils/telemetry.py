@@ -835,6 +835,11 @@ _PROM_HELP: Dict[str, str] = {
     "device_evictions": "Failover circuit-breaker device evictions",
     "block_splits": "OOM-triggered block split-retries by verb",
     "device_grant_timeouts": "Device acquisitions abandoned by watchdog",
+    "deadline_exceeded": "Verb deadline expiries by verb",
+    "verbs_shed": "Verbs rejected by admission control",
+    "admission_wait_seconds": "Time spent queued for a verb slot",
+    "admission_queue_depth": "Verbs queued for admission right now",
+    "admission_in_flight": "Admitted top-level verbs in flight",
     "oom_forensics": "Forensic snapshots captured for resource faults",
     "executor_cache_entries": "Live compiled-program cache entries",
     "live_device_buffers": "Live jax arrays across all devices",

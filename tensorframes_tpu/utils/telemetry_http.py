@@ -55,14 +55,22 @@ def _json_default(o):
 
 
 def _healthz_payload() -> dict:
+    from ..runtime.deadline import controller
     from ..runtime.scheduler import health_overview
 
     rows = health_overview()
-    degraded = any(r.get("state") not in (None, "closed") for r in rows)
+    admission = controller().snapshot()
+    circuits = any(r.get("state") not in (None, "closed") for r in rows)
+    overloaded = bool(admission.get("overloaded"))
+    degraded = circuits or overloaded
     return {
         "status": "degraded" if degraded else "ok",
         "degraded": degraded,
+        "overloaded": overloaded,
         "devices": rows,
+        # the overload state a load balancer keys on: in-flight vs
+        # limit, live queue depth, cumulative admitted/shed
+        "admission": admission,
     }
 
 

@@ -38,7 +38,7 @@ def _reset_telemetry():
     (circuit breakers are process-global) and ledger counts must never
     bleed into the next test's scheduling."""
     yield
-    from tensorframes_tpu.runtime import costmodel, faults
+    from tensorframes_tpu.runtime import costmodel, deadline, faults
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
@@ -46,3 +46,4 @@ def _reset_telemetry():
     faults.reset_ledger()
     device_health().reset()
     costmodel.reset()
+    deadline.reset()

@@ -231,7 +231,7 @@ class TestExecutorCacheCounters:
         assert set(s) == {
             "compile_count", "cache_hits", "cache_misses", "cache_entries",
             "jit_shape_compiles", "device_dispatches", "device_compiles",
-            "faults",
+            "faults", "admission",
         }
 
 

@@ -391,7 +391,7 @@ class TestExecutorStatsHonesty:
         assert s["jit_shape_compiles"] == s["compile_count"] == 0
         assert set(s) == {
             "compile_count", "cache_hits", "cache_misses", "cache_entries",
-            "jit_shape_compiles", "faults",
+            "jit_shape_compiles", "faults", "admission",
         }
 
     def test_program_shape_compiles_per_program(self):

@@ -69,6 +69,9 @@ SMOKE_SIZES = {
     "OVERLOAD_BLOCKS": "4",
     "OVERLOAD_CALLS": "6",
     "OVERLOAD_STORM": "3",
+    "SERVE_ROWS": "512",
+    "SERVE_CALLS": "24",
+    "SERVE_CLIENTS": "4",
 }
 
 
@@ -95,6 +98,7 @@ def main():
         "stream_overlap_bench",
         "ingest_bench",
         "overload_bench",
+        "serving_bench",
         # LAST THREE: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them

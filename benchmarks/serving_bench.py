@@ -1,0 +1,296 @@
+"""Serving bench: the multi-tenant runtime acceptance harness (ISSUE 10).
+
+Five contracts, asserted:
+
+1. **Micro-batching wins under concurrency.** N concurrent clients
+   hammering one endpoint through the HTTP front-end must beat the
+   SAME wire path driven serially by >= 1.3x throughput — coalescing
+   amortizes verb entry, jit-call overhead and H2D across the batch.
+   Needs >= 2 host cores (server, dispatcher and client threads must
+   actually overlap); self-gates with a reason line otherwise, like
+   scheduler_bench / ingest_bench.
+
+2. **Zero steady-state recompiles.** After `register` warm-compiles
+   the bucket ladder and one traffic round touches it, a full
+   concurrent round at varied request sizes adds ZERO jit shape
+   compiles.
+
+3. **Bit-identical to direct verb calls.** Every per-request response
+   equals the unbatched `map_blocks` result for the same rows.
+
+4. **Overload sheds typed, never hangs.** A burst beyond a 1-deep
+   lane queue behind a wedged dispatch returns HTTP 429 mapped back to
+   `OverloadError` with a positive retry-after; admitted requests
+   still finish, and admitted p99 stays within the SLO bound
+   (batch window + 1.5x uncontended p99 + floor).
+
+5. **Deadlines hold.** A request with a tiny budget against a hung
+   dispatch returns `DeadlineExceeded` within one backoff quantum of
+   its budget, and the server leaks no threads once shut down.
+
+Sizes: SERVE_ROWS (rows per request, 2048), SERVE_CALLS (requests per
+phase, 48), SERVE_CLIENTS (8).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def _p99(xs):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), 99.0))
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.runtime.executor import default_executor
+    from tensorframes_tpu.schema import ScalarType, Shape
+    from tensorframes_tpu.testing import faults as chaos
+
+    rows = scaled("SERVE_ROWS", 2048)
+    calls = scaled("SERVE_CALLS", 48)
+    n_clients = scaled("SERVE_CLIENTS", 8)
+    cores = os.cpu_count() or 1
+
+    # elementwise chain: row-local => batchable, and enough flops per
+    # row that the bench measures dispatch amortization, not numpy
+    x = dsl.placeholder(ScalarType.float32, shape=Shape((None,)), name="x")
+    two = dsl.constant(np.float32(2.0))
+    one = dsl.constant(np.float32(1.0))
+    fetch = ((((x * two) + one) * ((x * x) + two)) + one).named("score")
+
+    ep = tfs.serving.register(
+        "bench", fetch, {"x": "float32"}, max_batch_rows=rows * n_clients
+    )
+    ex = default_executor()
+    handle = tfs.serving.serve(port=0)
+    client = tfs.serving.ServingClient(handle.url)
+
+    rng = np.random.RandomState(0)
+    reqs = [
+        TensorFrame.from_dict(
+            # off-rung sizes: the batcher's padding path is the one
+            # under test, not the already-on-a-rung fast path
+            {"x": rng.rand(rows - 1 - (i % 7)).astype(np.float32)}
+        )
+        for i in range(calls)
+    ]
+    direct = [
+        np.asarray(ep.run_frame(r).column("score").host_values())
+        for r in reqs
+    ]
+
+    # ---- serial reference (same wire path, one client) ---------------
+    lat_serial = []
+    t0 = time.perf_counter()
+    for i, r in enumerate(reqs):
+        t1 = time.perf_counter()
+        out = client.run("bench", r, timeout_s=60.0, request_id=f"s{i}")
+        lat_serial.append(time.perf_counter() - t1)
+        assert np.array_equal(
+            np.asarray(out.column("score").host_values()), direct[i]
+        ), f"serial request {i} is not bit-identical to the direct verb"
+    wall_serial = time.perf_counter() - t0
+    rps_serial = calls / wall_serial
+    p99_serial = _p99(lat_serial)
+    emit("serving_serial_rps", rps_serial, "req/s")
+    emit("serving_serial_p99", p99_serial * 1e3, "ms")
+
+    # ---- steady-state compile check spans the concurrent phase -------
+    compiles_before = ex.jit_shape_compiles()
+
+    # ---- concurrent clients ------------------------------------------
+    lat_conc = []
+    failures = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients)
+    per_client = calls // n_clients
+
+    def run_client(ci):
+        try:
+            barrier.wait(timeout=60.0)
+            for k in range(per_client):
+                i = ci * per_client + k
+                t1 = time.perf_counter()
+                out = client.run(
+                    "bench", reqs[i], timeout_s=60.0,
+                    request_id=f"c{ci}-{k}",
+                )
+                dt = time.perf_counter() - t1
+                got = np.asarray(out.column("score").host_values())
+                assert np.array_equal(got, direct[i]), (
+                    f"concurrent request {i} is not bit-identical"
+                )
+                with lock:
+                    lat_conc.append(dt)
+        except Exception as e:  # noqa: BLE001 — reported below
+            with lock:
+                failures.append((ci, repr(e)))
+
+    threads = [
+        threading.Thread(target=run_client, args=(ci,))
+        for ci in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    wall_conc = time.perf_counter() - t0
+    assert not any(t.is_alive() for t in threads), "client threads wedged"
+    assert not failures, f"concurrent client failures: {failures}"
+
+    done = n_clients * per_client
+    rps_conc = done / wall_conc
+    p99_conc = _p99(lat_conc)
+    speedup = rps_conc / max(rps_serial, 1e-9)
+    emit("serving_concurrent_rps", rps_conc, "req/s")
+    emit("serving_concurrent_p99", p99_conc * 1e3, "ms")
+    emit("serving_batch_speedup", speedup, "x")
+
+    compile_delta = ex.jit_shape_compiles() - compiles_before
+    emit("serving_steady_state_compiles", float(compile_delta), "programs")
+    assert compile_delta == 0, (
+        f"steady-state traffic compiled {compile_delta} new shape(s) — "
+        "warm rungs + batch padding must cover every request"
+    )
+
+    snap = tfs.serving.batcher().snapshot()
+    emit("serving_batches", float(snap["batches"]), "dispatches")
+    emit(
+        "serving_mean_batch_fill",
+        snap["batched_requests"] / max(snap["batches"], 1),
+        "req/batch",
+    )
+    assert snap["batches"] < snap["batched_requests"], (
+        "no cross-request coalescing happened under "
+        f"{n_clients} concurrent clients: {snap}"
+    )
+
+    # admitted p99 SLO: coalescing trades at most one batch window of
+    # latency; beyond that the concurrent p99 must track uncontended
+    window_s = config.get().serve_batch_window_ms / 1e3
+    slo = window_s + 1.5 * p99_serial + 0.10
+    emit("serving_p99_slo", slo * 1e3, "ms")
+    assert p99_conc <= slo, (
+        f"admitted p99 {p99_conc * 1e3:.1f}ms exceeds the SLO bound "
+        f"{slo * 1e3:.1f}ms (window {window_s * 1e3:.0f}ms + 1.5x "
+        f"uncontended p99 {p99_serial * 1e3:.1f}ms + 100ms floor)"
+    )
+
+    if cores >= 2:
+        assert speedup >= 1.3, (
+            f"micro-batching speedup {speedup:.2f}x < 1.3x with "
+            f"{n_clients} clients on {cores} cores — coalescing is not "
+            "amortizing dispatch overhead"
+        )
+    else:
+        emit(
+            "serving speedup assertion skipped "
+            f"(host cores={cores}; concurrent wall-clock gain needs "
+            ">=2 cores)",
+            0,
+            "bool",
+        )
+
+    # ---- overload: 429 + Retry-After, admitted work finishes ---------
+    sheds, oks = [], []
+
+    def burst_client():
+        try:
+            out = client.run("bench", reqs[0], timeout_s=30.0)
+            oks.append(np.asarray(out.column("score").host_values()))
+        except tfs.OverloadError as e:
+            sheds.append(e)
+
+    with config.override(serve_queue_limit=1):
+        with chaos.inject(
+            rate=1.0, seed=1, fault="hang", delay_s=1.5, max_faults=1
+        ):
+            hold = threading.Thread(target=burst_client)
+            hold.start()
+            time.sleep(0.5)  # the lane dispatcher is inside the hang
+            burst = [
+                threading.Thread(target=burst_client) for _ in range(6)
+            ]
+            for t in burst:
+                t.start()
+            for t in burst:
+                t.join(timeout=120.0)
+            hold.join(timeout=120.0)
+    assert sheds, "burst beyond a 1-deep lane queue shed nothing"
+    assert all(e.retry_after_s > 0 for e in sheds), (
+        "429 without a positive Retry-After hint"
+    )
+    assert oks and all(np.array_equal(o, direct[0]) for o in oks), (
+        "admitted requests under overload are not bit-identical"
+    )
+    emit("serving_overload_shed", float(len(sheds)), "req")
+    emit("serving_overload_admitted", float(len(oks)), "req")
+
+    # ---- deadline: typed 504 within one backoff quantum --------------
+    budget = 0.3
+    t1 = time.perf_counter()
+    try:
+        with chaos.inject(rate=1.0, seed=2, fault="hang", delay_s=30.0):
+            client.run("bench", reqs[0], timeout_s=budget)
+        raise AssertionError("hung dispatch did not trip the deadline")
+    except tfs.DeadlineExceeded:
+        overshoot = time.perf_counter() - t1 - budget
+    quantum = config.get().retry_backoff_max_s
+    assert overshoot < quantum + 1.0, (
+        f"deadline overshoot {overshoot:.2f}s exceeds one backoff "
+        f"quantum ({quantum:.2f}s)"
+    )
+    emit("serving_deadline_overshoot", overshoot * 1e3, "ms")
+
+    # and the runtime is healthy afterwards: one clean call
+    out = client.run("bench", reqs[1], timeout_s=30.0)
+    assert np.array_equal(
+        np.asarray(out.column("score").host_values()), direct[1]
+    ), "post-storm serving is not bit-identical"
+
+    # ---- teardown leaks nothing --------------------------------------
+    before = {t.ident for t in threading.enumerate() if t.is_alive()}
+    tfs.serving.reset()
+    from tensorframes_tpu.utils import telemetry
+
+    telemetry.shutdown()
+    leaked = None
+    end = time.monotonic() + 10.0
+    while time.monotonic() < end:
+        now = {t.ident for t in threading.enumerate() if t.is_alive()}
+        leaked = {
+            t.name
+            for t in threading.enumerate()
+            if t.ident in (now - before) and t.is_alive()
+        }
+        stale = [
+            t
+            for t in threading.enumerate()
+            if t.is_alive()
+            and (
+                t.name.startswith("tfs-serve-")
+                or t.name == "tfs-telemetry-http"
+            )
+        ]
+        if not stale:
+            leaked = set()
+            break
+        time.sleep(0.05)
+    assert not leaked, f"serving teardown leaked threads: {leaked}"
+    emit("serving_teardown_leaked_threads", float(len(leaked or ())), "threads")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,7 @@ from .runtime.deadline import (
 from . import config
 from . import io
 from . import ingest
+from . import serving
 from .io import stream_dataset
 from . import utils
 from .utils import telemetry
@@ -100,6 +101,7 @@ __all__ = [
     "reduce_rows",
     "row",
     "ingest",
+    "serving",
     "stream_dataset",
     "Graph",
     "ShapeHints",

@@ -304,6 +304,54 @@ class Config:
     # un-deadlined callers in a service, or a stuck verb strands its
     # whole queue.
     admission_wait_timeout_s: float = 30.0
+    # Serving runtime (`serving/`): the multi-tenant front-end that
+    # keeps registered endpoint programs warm and coalesces concurrent
+    # small requests into one bucketed dispatch.
+    #
+    # serve_batch_window_ms: how long the micro-batcher holds an open
+    # batch for more requests before dispatching. A batch also closes
+    # EARLY the moment its row total lands exactly on a bucket-ladder
+    # rung (padding waste zero — waiting longer could only push it to
+    # the next rung) or reaches serve_max_batch_rows. 0 disables
+    # coalescing entirely: every request dispatches alone (the A/B
+    # baseline serving_bench measures against). Env override
+    # TFS_SERVE_BATCH_WINDOW_MS seeds the initial value.
+    serve_batch_window_ms: float = dataclasses.field(
+        default_factory=lambda: float(
+            __import__("os").environ.get("TFS_SERVE_BATCH_WINDOW_MS", "5")
+            or "5"
+        )
+    )
+    # serve_max_batch_rows: ceiling on one coalesced dispatch AND the
+    # top of the bucket ladder `serving.register(warm=True)` compiles
+    # at registration — requests whose batches stay under it hit only
+    # warmed rungs (zero steady-state compiles, asserted by
+    # serving_bench). A single oversized request still dispatches
+    # (alone), paying its own compile. Env override
+    # TFS_SERVE_MAX_BATCH_ROWS seeds the initial value.
+    serve_max_batch_rows: int = dataclasses.field(
+        default_factory=lambda: int(
+            __import__("os").environ.get("TFS_SERVE_MAX_BATCH_ROWS", "4096")
+            or "4096"
+        )
+    )
+    # serve_queue_limit: max requests queued per (endpoint x program)
+    # batching lane; arrivals beyond it are SHED immediately with a
+    # typed OverloadError (HTTP 429 + Retry-After at the server) so a
+    # slow endpoint builds bounded queues, never unbounded latency.
+    # 0 = unlimited (bounded only by admission control + deadlines).
+    serve_queue_limit: int = 256
+    # serve_default_timeout_s: per-request deadline the server applies
+    # when the client sends no X-TFS-Timeout-S header. Unlike
+    # default_verb_timeout_s (a library-wide opt-in), a serving request
+    # ALWAYS has a budget — an un-deadlined request behind a wedged
+    # endpoint would strand its server thread forever.
+    serve_default_timeout_s: float = 30.0
+    # serve_warm_compile: compile every bucket-ladder rung up to
+    # serve_max_batch_rows at `serving.register()` time (row-local
+    # endpoints only — others cannot pad, so rung warming cannot cover
+    # their request sizes). Off = first requests pay the compiles.
+    serve_warm_compile: bool = True
     # Device-grant watchdog (`runtime.faults.device_grant`): when > 0,
     # the scheduler's device acquisition runs under a watchdog thread
     # and falls back to the CPU backend with a loud one-time warning if

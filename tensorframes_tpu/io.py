@@ -22,6 +22,8 @@ __all__ = [
     "write_arrow_ipc",
     "read_arrow_ipc",
     "stream_arrow_ipc",
+    "frame_to_ipc_bytes",
+    "frame_from_ipc_bytes",
     "write_parquet",
     "read_parquet",
     "stream_parquet",
@@ -130,6 +132,47 @@ def _stream_arrow_ipc_single(
             yield TensorFrame.from_arrow(pa.Table.from_batches(group))
     finally:
         source.close()
+
+
+# ---------------------------------------------------------------------------
+# In-memory Arrow IPC — the serving runtime's wire format (server and
+# client bodies both go through these two helpers, so request/response
+# framing cannot drift between the two ends).
+# ---------------------------------------------------------------------------
+
+
+def frame_to_ipc_bytes(frame: TensorFrame) -> bytes:
+    """Serialize a frame to Arrow IPC STREAM bytes, one record batch per
+    block (block structure survives the round trip like
+    `write_arrow_ipc`, without touching the filesystem)."""
+    import pyarrow as pa
+
+    table = frame.to_arrow()
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        for bi in range(frame.num_blocks):
+            lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+            writer.write_batch(
+                pa.RecordBatch.from_struct_array(
+                    table.slice(lo, hi - lo).to_struct_array().combine_chunks()
+                )
+            )
+    return sink.getvalue().to_pybytes()
+
+
+def frame_from_ipc_bytes(data: bytes) -> TensorFrame:
+    """Rebuild a frame from `frame_to_ipc_bytes` output (record batches
+    become blocks when they account for every row, exactly like the file
+    reader)."""
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(pa.BufferReader(data)) as reader:
+        batches = [b for b in reader]
+        schema = reader.schema
+    table = pa.Table.from_batches(batches, schema=schema)
+    return _frame_with_offsets(
+        table, [b.num_rows for b in batches], None
+    )
 
 
 # ---------------------------------------------------------------------------

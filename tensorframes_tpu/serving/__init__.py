@@ -1,0 +1,77 @@
+"""Multi-tenant serving runtime: the piece that turns the library into
+a service.
+
+The four modules compose the heavy-traffic north star out of machinery
+earlier PRs built — the PR 3 shape ladder makes cross-request batching
+shape-compatible by construction, the PR 5 scheduler places the
+coalesced dispatch, PR 9's admission control and deadlines bound load
+and latency, and the PR 8 endpoint is the shared HTTP surface:
+
+- `registry` — named endpoints: schema-validated programs, warm-
+  compiled across every bucket-ladder rung (zero steady-state
+  compiles).
+- `batcher` — cross-request micro-batching: concurrent small requests
+  coalesce into ONE bucketed dispatch, results scatter back through
+  futures, bit-identical to unbatched execution.
+- `server` / `client` — Arrow IPC over HTTP on the shared process
+  endpoint, with typed overload (429 + Retry-After) and deadline (504)
+  mapping.
+
+Quick start::
+
+    import tensorframes_tpu as tfs
+
+    fetch = ...  # dsl tensor / Graph / GraphDef / LazyFrame
+    tfs.serving.register("score", fetch, {"x": "float32"})
+    handle = tfs.serving.serve(port=0)
+
+    client = tfs.serving.ServingClient(handle.url)
+    out = client.run("score", {"x": np.arange(8, dtype=np.float32)})
+"""
+
+from __future__ import annotations
+
+from .batcher import MicroBatcher, batcher
+from .client import ServingClient, ServingError
+from .registry import (
+    Endpoint,
+    endpoints,
+    get,
+    register,
+    unregister,
+)
+from .server import ServingHandle, active, serve
+
+__all__ = [
+    "Endpoint",
+    "register",
+    "unregister",
+    "get",
+    "endpoints",
+    "MicroBatcher",
+    "batcher",
+    "serve",
+    "active",
+    "ServingHandle",
+    "ServingClient",
+    "ServingError",
+    "reset",
+]
+
+
+def reset() -> None:
+    """Test hook: unmount the front-end, stop every batching lane,
+    forget every endpoint — the serving analogue of
+    `telemetry.reset()`."""
+    from . import server as _server
+
+    handle = _server.active()
+    if handle is not None:
+        handle.close()  # unmounts AND clears the active-handle global
+    else:
+        from ..utils import telemetry_http as _http
+
+        _http.unmount(_server.PREFIX)
+    from . import registry as _registry
+
+    _registry.reset()

@@ -69,6 +69,9 @@ __all__ = [
     "diagnostics_data",
     "serve",
     "maybe_serve",
+    "shutdown",
+    "request_scope",
+    "current_request",
     "reset",
     "reset_counters",
 ]
@@ -157,6 +160,14 @@ _PROGRAM: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
 _VERB: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
     "tfs_current_verb", default=None
 )
+# serving request attribution: the HTTP front-end (serving/server.py)
+# and the micro-batcher's dispatcher set this around the verbs a
+# request triggers, and every verb span under it stamps it as a
+# ``request=`` label — diagnostics and Chrome traces then attribute
+# work per request (a coalesced batch carries the joined ids)
+_REQUEST: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "tfs_current_request", default=None
+)
 
 _annotation_cls = None  # resolved once; False = unavailable
 
@@ -225,6 +236,9 @@ class _SpanCtx:
             # the verb contextvar: what the cost ledger attributes
             # per-verb footprint high-water marks to
             self.vtok = _VERB.set(self.name)
+            rid = _REQUEST.get()
+            if rid is not None:
+                self.attrs["request"] = rid
         ann = _annotation(self.name)
         self.ann = ann
         if ann is not None:
@@ -293,6 +307,36 @@ def current_verb() -> Optional[str]:
     """Name of the enclosing ``verb`` span, if any (the cost ledger's
     per-verb attribution key)."""
     return _VERB.get()
+
+
+def current_request() -> Optional[str]:
+    """Request id of the enclosing `request_scope`, if any."""
+    return _REQUEST.get()
+
+
+class _RequestScope:
+    """Context manager setting the ambient request id (serving request
+    attribution — see the ``_REQUEST`` contextvar). Class-based like
+    `_SpanCtx`: this wraps every served request."""
+
+    __slots__ = ("rid", "tok")
+
+    def __init__(self, rid: str):
+        self.rid = rid
+
+    def __enter__(self):
+        self.tok = _REQUEST.set(self.rid)
+        return self.rid
+
+    def __exit__(self, et, ev, tb):
+        _REQUEST.reset(self.tok)
+        return False
+
+
+def request_scope(request_id: str):
+    """Label every verb span started inside with ``request=<id>`` —
+    the serving front-end's per-request span attribution hook."""
+    return _RequestScope(str(request_id))
 
 
 def current_span_id() -> Optional[int]:
@@ -1313,6 +1357,17 @@ def maybe_serve():
             "config.telemetry_port): %s: %s", type(e).__name__, e,
         )
         return None
+
+
+def shutdown() -> bool:
+    """Gracefully stop the process-wide telemetry/serving HTTP endpoint
+    (`utils.telemetry_http`): unbinds the port, joins the serve thread.
+    Returns True when a server was running, False when this was a no-op.
+    Mounted routes (the serving front-end) stay registered — a later
+    `serve()` picks them up again."""
+    from . import telemetry_http as _http
+
+    return _http.shutdown()
 
 
 def diagnostics(executor=None, format: str = "text"):

@@ -27,6 +27,13 @@ payloads expose program fingerprints, file-path labels and device
 state, and there is NO authentication — exposing the port beyond
 localhost is a deliberate operator decision (front it with a real
 reverse proxy if you must).
+
+Route mounts: other subsystems share THIS one process server instead
+of binding their own port — `mount(prefix, handler)` registers a
+handler for every GET/POST under ``prefix`` (longest prefix wins; the
+serving front-end mounts ``/serve``). `shutdown()` is the graceful
+stop: unbind the port, join the serve thread, keep mounts registered
+for the next `serve()`.
 """
 
 from __future__ import annotations
@@ -34,12 +41,55 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
-__all__ = ["TelemetryServer", "serve", "active_server"]
+__all__ = [
+    "TelemetryServer",
+    "serve",
+    "shutdown",
+    "active_server",
+    "mount",
+    "unmount",
+    "mounts",
+]
 
 _lock = threading.Lock()
 _server: Optional["TelemetryServer"] = None
+
+# prefix -> handler(method, path, headers, body) ->
+#   (status, content_type, body_bytes, extra_headers | None).
+# A mounted handler owns its whole subtree; raising inside it returns a
+# JSON 500 (a bad route must never kill the shared server).
+MountHandler = Callable[..., Tuple[int, str, bytes, Optional[Dict[str, str]]]]
+_mounts: Dict[str, MountHandler] = {}
+
+
+def mount(prefix: str, handler: MountHandler, replace: bool = False) -> None:
+    """Register ``handler`` for every request whose path is ``prefix``
+    or lives under ``prefix/``. One handler per prefix (``replace=True``
+    swaps it — re-`serve()`d front-ends re-mount idempotently)."""
+    if not prefix.startswith("/") or prefix.rstrip("/") == "":
+        raise ValueError(f"mount prefix must be a non-root path, got {prefix!r}")
+    prefix = prefix.rstrip("/")
+    with _lock:
+        if prefix in _mounts and not replace:
+            raise ValueError(
+                f"route prefix {prefix!r} is already mounted; pass "
+                "replace=True to swap the handler"
+            )
+        _mounts[prefix] = handler
+
+
+def unmount(prefix: str) -> bool:
+    """Remove a mounted prefix; True when something was removed."""
+    with _lock:
+        return _mounts.pop(prefix.rstrip("/"), None) is not None
+
+
+def mounts() -> Dict[str, MountHandler]:
+    """Snapshot of the mounted prefixes (for the root route listing)."""
+    with _lock:
+        return dict(_mounts)
 
 
 def _json_default(o):
@@ -90,9 +140,53 @@ class _Handler(BaseHTTPRequestHandler):
         body = json.dumps(obj, default=_json_default).encode()
         self._send(code, body, "application/json")
 
+    def _try_mounted(self, method: str) -> bool:
+        """Dispatch to a mounted route handler when one owns this path
+        (longest prefix wins). Returns True when the request was
+        handled — mounted or not, errors included."""
+        path = self.path.split("?", 1)[0]
+        norm = path.rstrip("/") or "/"
+        best = None
+        for prefix, fn in mounts().items():
+            if norm == prefix or path.startswith(prefix + "/"):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, fn)
+        if best is None:
+            return False
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length > 0 else b""
+            status, ctype, out, extra = best[1](
+                method, path, self.headers, body
+            )
+            self.send_response(int(status))
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(out)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(out)
+        except Exception as e:  # a mounted route must never kill the server
+            try:
+                self._send_json(
+                    {"error": f"{type(e).__name__}: {e}"}, code=500
+                )
+            except Exception:
+                pass  # client hung up mid-error
+        return True
+
+    def do_POST(self):  # noqa: N802 - stdlib name
+        if self._try_mounted("POST"):
+            return
+        self._send_json(
+            {"error": f"no POST route {self.path!r}"}, code=404
+        )
+
     def do_GET(self):  # noqa: N802 - stdlib name
         from . import telemetry as _tele
 
+        if self._try_mounted("GET"):
+            return
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
             if path == "/metrics":
@@ -114,7 +208,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "routes": [
                             "/metrics", "/healthz", "/diagnostics",
                             "/trace",
-                        ],
+                        ] + sorted(mounts()),
                     }
                 )
             else:
@@ -167,6 +261,28 @@ def active_server() -> Optional[TelemetryServer]:
     """The process-wide endpoint, if one is serving."""
     with _lock:
         return _server
+
+
+def shutdown() -> bool:
+    """Gracefully stop the process-wide endpoint: unbind the port and
+    join the serve thread synchronously (in-flight requests finish —
+    `ThreadingHTTPServer.shutdown` drains the accept loop). No-op
+    (returns False) when nothing is serving; mounted routes stay
+    registered for the next `serve()`. Fixes the "one daemon server per
+    process, no stop" gap: a test or embedding application can now
+    cycle the endpoint without leaking the port for the process
+    lifetime."""
+    with _lock:
+        srv = _server
+    if srv is None:
+        return False
+    srv.close()
+    from .log import get_logger
+
+    get_logger("telemetry").info(
+        "telemetry endpoint on port %d shut down", srv.port
+    )
+    return True
 
 
 def serve(

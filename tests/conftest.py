@@ -38,10 +38,12 @@ def _reset_telemetry():
     (circuit breakers are process-global) and ledger counts must never
     bleed into the next test's scheduling."""
     yield
+    from tensorframes_tpu import serving
     from tensorframes_tpu.runtime import costmodel, deadline, faults
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
+    serving.reset()  # before telemetry: lanes may still emit counters
     telemetry.reset()
     faults.reset_ledger()
     device_health().reset()

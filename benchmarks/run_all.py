@@ -107,6 +107,27 @@ def main():
         "train_bench",
     ):
         runpy.run_path(os.path.join(here, f"{mod}.py"), run_name="__main__")
+    _save_profile()
+
+
+def _save_profile():
+    """Emit the run's workload profile alongside the BENCH JSON lines:
+    every bench run leaves a durable `WorkloadProfile` artifact
+    (programs/rungs, bucket fill, verb latencies, cost-model
+    residuals) that `tools/profile_report.py` renders/diffs offline —
+    the cross-run evidence the autotuning ROADMAP item consumes.
+    BENCH_PROFILE overrides the path; "0"/"off" disables. Never fails
+    the bench run."""
+    path = os.environ.get("BENCH_PROFILE", "bench_profile.json")
+    if not path or path.lower() in ("0", "off", "none"):
+        return
+    try:
+        from tensorframes_tpu.runtime import profiler
+
+        profiler.snapshot(note="benchmarks/run_all").save(path)
+        print(f"PROFILE_ARTIFACT {path}")
+    except Exception as e:  # the artifact must never fail the bench
+        print(f"PROFILE_ARTIFACT error {type(e).__name__}: {e}")
 
 
 if __name__ == "__main__":

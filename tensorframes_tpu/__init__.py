@@ -47,6 +47,7 @@ from .api import (
     reduce_rows,
     row,
 )
+from .lazy import explain_analyze
 from .graph import Graph, ShapeHints
 from .graph import builder as dsl
 from .runtime import Executor
@@ -64,6 +65,10 @@ from .io import stream_dataset
 from . import utils
 from .utils import telemetry
 from .utils.telemetry import diagnostics
+
+# the persistent workload-profile surface: tfs.profile.snapshot() /
+# .load() / WorkloadProfile.save/merge/diff (runtime/profiler.py)
+from .runtime import profiler as profile
 
 # Live telemetry endpoint auto-start: serve /metrics /healthz
 # /diagnostics /trace IFF the operator set TFS_TELEMETRY_PORT /
@@ -88,6 +93,7 @@ __all__ = [
     "block",
     "block_to_row",
     "explain",
+    "explain_analyze",
     "cost_analysis",
     "executor_stats",
     "explain_hlo",
@@ -113,4 +119,5 @@ __all__ = [
     "deadline_scope",
     "telemetry",
     "diagnostics",
+    "profile",
 ]

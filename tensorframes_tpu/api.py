@@ -344,20 +344,30 @@ def _dispatch_reduce_block(
 
     def run(lo_, hi_, depth):
         feeds = feeds_for(lo_, hi_)
+        bucket = None
+        if mask_plan is not None:
+            # pad ONCE per logical dispatch, OUTSIDE the retried thunk
+            # (the same discipline as the map paths): a transient
+            # retry re-dispatches the already-padded feeds instead of
+            # re-padding, and pad_feeds' bucket_fill observation fires
+            # exactly once per logical dispatch, not once per attempt
+            feeds, bucket = _sp.pad_feeds(feeds, hi_ - lo_)
 
         def _thunk():
             # per-attempt span: retried/failed-over attempts each
-            # charge the device they actually dispatched to
+            # charge the device they actually dispatched to; a masked
+            # dispatch labels its bucket rung (the dispatched lead
+            # dim) so pad waste and the ledger-shape join see it
             with _tele.dispatch_span(
                 span_name, program=fp, block=bi, rows=hi_ - lo_,
+                bucket=bucket,
                 masked=mask_plan is not None or None,
                 device=sched.label(bi) if sched is not None else None,
             ):
                 if mask_plan is not None:
                     if sched is not None:
-                        pfeeds, _ = _sp.pad_feeds(feeds, hi_ - lo_)
-                        return sched.bind(bi, fn, valid=hi_ - lo_)(*pfeeds)
-                    return _sp.dispatch_masked(fn, feeds, hi_ - lo_)
+                        return sched.bind(bi, fn, valid=hi_ - lo_)(*feeds)
+                    return fn(np.int32(hi_ - lo_), *feeds)
                 if sched is not None:
                     return sched.bind(bi, fn)(*feeds)
                 return fn(*feeds)
@@ -371,9 +381,6 @@ def _dispatch_reduce_block(
         except Exception as e:
             if _flt.classify(e) != _flt.RESOURCE:
                 raise
-            bucket = (
-                _sp.bucket_for(hi_ - lo_) if mask_plan is not None else None
-            )
             if split_combs is None:
                 # OOM on an unclassifiable reduce: no monoid recipe to
                 # combine halves — re-raise the original error, with

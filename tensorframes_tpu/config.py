@@ -33,6 +33,24 @@ from typing import Optional
 __all__ = ["Config", "get", "update", "override"]
 
 
+def _env_histogram_buckets():
+    """Seed ``histogram_buckets`` from TFS_HISTOGRAM_BUCKETS (a JSON
+    dict: family or metric name -> ascending boundary list). Malformed
+    JSON must never break the package import — it reads as None (the
+    built-in defaults) and the bad value is simply ignored."""
+    import json
+    import os
+
+    raw = os.environ.get("TFS_HISTOGRAM_BUCKETS", "")
+    if not raw:
+        return None
+    try:
+        val = json.loads(raw)
+        return val if isinstance(val, dict) else None
+    except Exception:
+        return None
+
+
 @dataclasses.dataclass
 class Config:
     matmul_precision: str = "highest"
@@ -201,6 +219,32 @@ class Config:
         )
     )
     telemetry_host: str = "127.0.0.1"
+    # Histogram bucket boundaries (`utils.telemetry`): override the
+    # fixed per-family ladders by bucket FAMILY ("seconds" | "rows" |
+    # "bytes" | "fraction") or by exact metric name ("verb_seconds" —
+    # the name wins over its family). Value: ascending float list. The
+    # built-in defaults are unchanged (exports stay byte-identical
+    # until an operator opts in); a service whose latencies live in one
+    # default bucket (ms-scale serving) sets e.g.
+    # {"verb_seconds": [1e-4, 5e-4, 1e-3, ...]}. Applies to histogram
+    # series CREATED after the change (existing series keep the ladder
+    # they were born with — fixed buckets are what make concurrent
+    # observation and merge well-defined); `telemetry.reset()` rebuilds
+    # everything at the current value. Env override
+    # TFS_HISTOGRAM_BUCKETS (JSON dict) seeds the initial value.
+    histogram_buckets: Optional[dict] = dataclasses.field(
+        default_factory=_env_histogram_buckets
+    )
+    # Cost-model accuracy warning threshold (`runtime.costmodel
+    # .residuals`): a program whose span-achieved time per dispatch is
+    # more than this factor away (either direction) from the cost
+    # model's prediction is flagged in the diagnostics "cost-model
+    # accuracy" section and in saved workload profiles. The residual is
+    # RELATIVE — predictions use a per-process effective throughput
+    # fitted over every attributed program, so a flag means "the model
+    # misprices this program vs its peers", which is exactly what a
+    # cost-based planner needs to distrust. 0 disables flagging.
+    cost_residual_warn_ratio: float = 4.0
     # Always-on cost/memory ledger (`runtime.costmodel`): every XLA
     # shape specialization of a cached program captures the compiler's
     # modeled flops / HBM bytes (from the lowered module's cost

@@ -58,7 +58,10 @@ from .schema import ColumnInfo, FrameInfo, ScalarType
 # at call time through the module object (same pattern as streaming.py)
 from . import api as _api
 
-__all__ = ["lazy", "lazy_active", "LazyFrame", "LazyStage", "LazyPlan"]
+__all__ = [
+    "lazy", "lazy_active", "LazyFrame", "LazyStage", "LazyPlan",
+    "explain_analyze",
+]
 
 
 _LAZY_MODE: contextvars.ContextVar[bool] = contextvars.ContextVar(
@@ -413,15 +416,21 @@ class LazyFrame:
                 attr = ph.shape_attr
                 if attr is None or shp.check_more_precise_than(attr):
                     overrides[ph.name] = shp
-        rsummary = analyze_graph(rgraph, rfetch, placeholder_shapes=overrides)
-        _api._validate_reduce_blocks(rsummary, rfetch)
+        from .utils import telemetry as _tele
 
-        bindings, new_feeds = self._resolve_placeholders(
-            rgraph, feed_dict, "reduce_blocks"
-        )
-        fused, fused_fetches, rename = splice(
-            self._graph, rgraph, bindings, rfetch
-        )
+        with _tele.span("lazy.analyze", kind="stage"):
+            rsummary = analyze_graph(
+                rgraph, rfetch, placeholder_shapes=overrides
+            )
+            _api._validate_reduce_blocks(rsummary, rfetch)
+
+        with _tele.span("lazy.fuse", kind="stage", verb="reduce_blocks"):
+            bindings, new_feeds = self._resolve_placeholders(
+                rgraph, feed_dict, "reduce_blocks"
+            )
+            fused, fused_fetches, rename = splice(
+                self._graph, rgraph, bindings, rfetch
+            )
         feed_map = dict(self._feed_map)
         for ph, col in new_feeds.items():
             feed_map[rename[ph]] = col
@@ -501,29 +510,39 @@ class LazyFrame:
                 fp = fused.fingerprint()
                 partials: List[Tuple] = []
                 owners: List[int] = []
-                for bi in range(frame.num_blocks):
-                    lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-                    if lo == hi:
-                        # zero-row blocks never dispatch (a padded all-pad
-                        # block would emit the bare reduction identity and
-                        # poison the combine — e.g. +inf partials for Min)
-                        continue
-                    outs = _api._dispatch_reduce_block(
-                        "reduce_blocks.fused.block", fp, fn, mask_plan,
-                        sched, fscope, bi, lo, hi,
-                        lambda lo_, hi_: [
-                            frame.column(feed_map[n]).values[lo_:hi_]
-                            for n in feed_names
-                        ],
-                        split_combs, "reduce_blocks.fused",
-                    )
-                    maybe_check_numerics(
-                        rfetch, outs, f"reduce_blocks (fused) block {bi}"
-                    )
-                    partials.append(tuple(outs))
-                    owners.append(
-                        sched.slot(bi) if sched is not None else 0
-                    )
+                # stage spans around the block loop and the combine:
+                # per-block host prep (feed slicing, ladder padding)
+                # is part of the execute stage's cost, and
+                # explain_analyze attributes plan wall time by these
+                # stage windows — not only by the dispatch leaves
+                with _tele.span(
+                    "reduce_blocks.fused.blocks", kind="stage",
+                    program=fp,
+                ):
+                    for bi in range(frame.num_blocks):
+                        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+                        if lo == hi:
+                            # zero-row blocks never dispatch (a padded
+                            # all-pad block would emit the bare reduction
+                            # identity and poison the combine — e.g. +inf
+                            # partials for Min)
+                            continue
+                        outs = _api._dispatch_reduce_block(
+                            "reduce_blocks.fused.block", fp, fn, mask_plan,
+                            sched, fscope, bi, lo, hi,
+                            lambda lo_, hi_: [
+                                frame.column(feed_map[n]).values[lo_:hi_]
+                                for n in feed_names
+                            ],
+                            split_combs, "reduce_blocks.fused",
+                        )
+                        maybe_check_numerics(
+                            rfetch, outs, f"reduce_blocks (fused) block {bi}"
+                        )
+                        partials.append(tuple(outs))
+                        owners.append(
+                            sched.slot(bi) if sched is not None else 0
+                        )
                 if not partials:
                     raise ValueError("reduce_blocks on an empty frame")
                 if len(partials) == 1:
@@ -545,20 +564,23 @@ class LazyFrame:
 
                         return combine
 
-                    if sched is not None:
-                        final = _api._combine_partials_scheduled(
-                            ex, "reduce-combine", rgraph, rfetch,
-                            rfeed_names, build_block_combine, partials,
-                            owners, sched,
-                            assoc=_api._assoc_reduce(
-                                rgraph, rfetch, rsummary
-                            ),
-                        )
-                    else:
-                        final = _api._combine_partials(
-                            ex, "reduce-combine", rgraph, rfetch,
-                            rfeed_names, build_block_combine, partials,
-                        )
+                    with _tele.span(
+                        "reduce_blocks.fused.combine", kind="stage"
+                    ):
+                        if sched is not None:
+                            final = _api._combine_partials_scheduled(
+                                ex, "reduce-combine", rgraph, rfetch,
+                                rfeed_names, build_block_combine, partials,
+                                owners, sched,
+                                assoc=_api._assoc_reduce(
+                                    rgraph, rfetch, rsummary
+                                ),
+                            )
+                        else:
+                            final = _api._combine_partials(
+                                ex, "reduce-combine", rgraph, rfetch,
+                                rfeed_names, build_block_combine, partials,
+                            )
         if len(rfetch) == 1:
             return final[0]
         return {_base(f): v for f, v in zip(rfetch, final)}
@@ -697,49 +719,58 @@ class LazyFrame:
                     return _sp.slice_pad_rows(outs, hi_ - lo_, bucket)
 
                 acc: Dict[str, List] = {n: [] for n in out_names}
-                for bi in range(frame.num_blocks):
-                    lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-                    if lo == hi:
-                        continue
-                    outs = _dispatch_rows(bi, lo, hi, 0)
-                    maybe_check_numerics(
-                        out_names, outs, f"lazy fused block {bi}"
-                    )
-                    for n, o in zip(out_names, outs):
-                        if o.ndim == 0 or o.shape[0] != hi - lo:
-                            raise ValueError(
-                                f"lazy plan output {n!r} does not preserve "
-                                "the block row count; trimmed/reducing "
-                                "stages cannot be part of a lazy map plan"
-                            )
-                        acc[n].append(o)
-                vinfo = self.info
-                anchor = (
-                    sched.anchor_device() if sched is not None else None
-                )
-                out_cols = []
-                for n in out_names:
-                    parts = acc[n]
-                    if parts:
-                        data = _api._concat_parts(parts, anchor)
-                    else:  # all blocks empty: zero-row column from analysis
-                        ci = vinfo[n]
-                        data = np.zeros(
-                            (0,)
-                            + tuple(
-                                0 if d is None else d
-                                for d in ci.cell_shape.dims
-                            ),
-                            dtype=ci.dtype.np_dtype,
+                # stage spans: the block loop (host prep + dispatch)
+                # and output collection are the plan stages
+                # explain_analyze attributes wall time to
+                with _tele.span(
+                    "lazy.force.blocks", kind="stage", program=fp
+                ):
+                    for bi in range(frame.num_blocks):
+                        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
+                        if lo == hi:
+                            continue
+                        outs = _dispatch_rows(bi, lo, hi, 0)
+                        maybe_check_numerics(
+                            out_names, outs, f"lazy fused block {bi}"
                         )
-                    out_cols.append(Column(n, data))
-                shadow = set(out_names)
-                cols = out_cols + [
-                    frame.column(c)
-                    for c in frame.columns
-                    if c not in shadow
-                ]
-                out = TensorFrame(cols, frame.offsets)
+                        for n, o in zip(out_names, outs):
+                            if o.ndim == 0 or o.shape[0] != hi - lo:
+                                raise ValueError(
+                                    f"lazy plan output {n!r} does not "
+                                    "preserve the block row count; "
+                                    "trimmed/reducing stages cannot be "
+                                    "part of a lazy map plan"
+                                )
+                            acc[n].append(o)
+                vinfo = self.info
+                with _tele.span("lazy.force.collect", kind="stage"):
+                    anchor = (
+                        sched.anchor_device() if sched is not None else None
+                    )
+                    out_cols = []
+                    for n in out_names:
+                        parts = acc[n]
+                        if parts:
+                            data = _api._concat_parts(parts, anchor)
+                        else:  # all blocks empty: zero-row column from
+                            # analysis
+                            ci = vinfo[n]
+                            data = np.zeros(
+                                (0,)
+                                + tuple(
+                                    0 if d is None else d
+                                    for d in ci.cell_shape.dims
+                                ),
+                                dtype=ci.dtype.np_dtype,
+                            )
+                        out_cols.append(Column(n, data))
+                    shadow = set(out_names)
+                    cols = out_cols + [
+                        frame.column(c)
+                        for c in frame.columns
+                        if c not in shadow
+                    ]
+                    out = TensorFrame(cols, frame.offsets)
         if executor is None and mesh is None and devices is None:
             self._forced = out
         return out
@@ -810,3 +841,301 @@ class LazyFrame:
             lines.append(f"  pending: {c} = {self._sources[c]}")
         lines.append(self.info.explain())
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# explain_analyze: execute a plan and join observed spans with the
+# cost ledger (the EXPLAIN ANALYZE of the lazy planner)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s * 1e6:.0f}us"
+
+
+def _analyze_window(new_spans, wall_s: float, dropped: int) -> Dict:
+    """Join one execution window's spans with the cost ledger into the
+    explain_analyze payload (shared by text and json renderings)."""
+    from .runtime import costmodel as _cm
+    from .utils import telemetry as _tele
+
+    ids = {s.span_id for s in new_spans}
+    agg = _tele.span_aggregates(new_spans)
+    # stage attribution: everything below (or beside) the verb roots —
+    # plan stages, per-block dispatches, compiles, transfers and host
+    # syncs. Verb spans span the whole window by construction; counting
+    # them would make 100% attribution a tautology instead of a
+    # measurement.
+    non_verb = [s for s in new_spans if s.kind != "verb"]
+    attributed = _tele._union_seconds([(s.t0, s.t1) for s in non_verb])
+    stages: Dict[Tuple[str, str], Dict] = {}
+    per_prog: Dict[str, Dict] = {}
+    for s in new_spans:
+        st = stages.setdefault(
+            (s.name, s.kind),
+            {
+                "name": s.name, "kind": s.kind, "count": 0,
+                "seconds": 0.0, "rows": 0, "pad_rows": 0,
+                "devices": set(), "programs": set(),
+            },
+        )
+        st["count"] += 1
+        st["seconds"] += s.seconds
+        prog = s.attrs.get("program")
+        if prog:
+            st["programs"].add(str(prog))
+        if s.kind == "dispatch":
+            rows = int(s.attrs.get("rows") or 0)
+            bucket = s.attrs.get("bucket")
+            pad = max(0, int(bucket) - rows) if bucket is not None else 0
+            st["rows"] += rows
+            st["pad_rows"] += pad
+            dev = s.attrs.get("device")
+            if dev:
+                st["devices"].add(str(dev))
+            if prog:
+                p = per_prog.setdefault(
+                    str(prog),
+                    {
+                        "rows": 0, "pad_rows": 0, "rungs": set(),
+                        "devices": set(),
+                    },
+                )
+                p["rows"] += rows
+                p["pad_rows"] += pad
+                if bucket is not None:
+                    p["rungs"].add(int(bucket))
+                elif rows:
+                    p["rungs"].add(rows)
+                if dev:
+                    p["devices"].add(str(dev))
+    stage_rows = [
+        {
+            **st,
+            "devices": sorted(st["devices"]),
+            "programs": sorted(st["programs"]),
+        }
+        for st in stages.values()
+    ]
+    stage_rows.sort(key=lambda r: -r["seconds"])
+
+    # modeled-vs-achieved per program over THIS window only
+    roof = {r["program"]: r for r in _cm.roofline(agg["by_program"])}
+    res = _cm.residuals(new_spans)
+    res_progs = res.get("programs", {})
+    programs = []
+    for fp in sorted(agg["by_program"]):
+        p = agg["by_program"][fp]
+        if not p["dispatches"] and not p["compiles"]:
+            # a plan-analysis span's program attr, not an execution
+            continue
+        r = roof.get(fp, {})
+        extra = per_prog.get(fp, {})
+        rr = res_progs.get(fp, {})
+        programs.append(
+            {
+                "program": fp,
+                "dispatches": int(p["dispatches"]),
+                "execute_s": p["execute_s"],
+                "compiles": int(p["compiles"]),
+                "compile_s": p["compile_s"],
+                "host_syncs": int(p["host_syncs"]),
+                "host_sync_s": p["host_sync_s"],
+                "rows": extra.get("rows", 0),
+                "pad_rows": extra.get("pad_rows", 0),
+                "bucket_rungs": sorted(extra.get("rungs", ())),
+                "devices": sorted(extra.get("devices", ())),
+                "modeled_flops_per_exec": r.get("flops_per_exec"),
+                "modeled_bytes_per_exec": r.get("bytes_per_exec"),
+                "modeled_footprint_bytes": r.get("footprint_bytes"),
+                "achieved_flops_s": r.get("achieved_flops_s"),
+                "achieved_hbm_bytes_s": r.get("achieved_hbm_bytes_s"),
+                "flops_frac_of_peak": r.get("flops_frac_of_peak"),
+                "hbm_frac_of_peak": r.get("hbm_frac_of_peak"),
+                "residual_ratio": rr.get("residual_ratio"),
+            }
+        )
+    roots = [
+        s for s in new_spans
+        if s.parent_id is None or s.parent_id not in ids
+    ]
+    return {
+        "wall_s": wall_s,
+        "attributed_s": attributed,
+        "coverage": min(1.0, attributed / max(wall_s, 1e-12)),
+        "spans": len(new_spans),
+        "spans_dropped_during": dropped,
+        "roots": len(roots),
+        "stages": stage_rows,
+        "programs": programs,
+        "accuracy_fit": res.get("fit"),
+    }
+
+
+def _render_explain_analyze(data: Dict) -> str:
+    from .utils.telemetry import _fmt_bytes, _fmt_rate
+
+    lines = [
+        f"explain_analyze: {_fmt_seconds(data['wall_s'])} wall, "
+        f"{data['coverage'] * 100:.1f}% attributed to "
+        f"{len(data['stages'])} stage group(s) "
+        f"({data['spans']} span(s), {data['roots']} root(s))"
+    ]
+    if data["spans_dropped_during"]:
+        lines.append(
+            f"  WARNING: {data['spans_dropped_during']} span(s) fell "
+            "off the ring during execution — attribution is partial; "
+            "raise config.telemetry_ring_entries"
+        )
+    plan = data.get("plan")
+    if plan:
+        lines.append(
+            f"plan: {len(plan['stages'])} fused stage(s), "
+            f"{plan['nodes']} node(s), feeds {plan['feeds']}"
+        )
+        for i, st in enumerate(plan["stages"], 1):
+            outs = ", ".join(st["outputs"])
+            lines.append(
+                f"  stage {i}: {st['verb']} -> [{outs}] "
+                f"(+{st['nodes']} node(s))"
+            )
+    lines.append("observed stages (by span group, slowest first):")
+    for st in data["stages"]:
+        extra = ""
+        if st["rows"]:
+            extra += f" rows={st['rows']}"
+        if st["pad_rows"]:
+            extra += f" pad_rows={st['pad_rows']}"
+        if st["devices"]:
+            extra += f" devices={','.join(st['devices'])}"
+        lines.append(
+            f"  {st['name']:<28} {st['kind']:<9} x{st['count']:<4} "
+            f"{_fmt_seconds(st['seconds'])}{extra}"
+        )
+    if data["programs"]:
+        lines.append("programs (modeled vs achieved, this execution):")
+        for p in data["programs"]:
+            lines.append(
+                f"  {p['program']:<16} dispatches={p['dispatches']} "
+                f"execute={_fmt_seconds(p['execute_s'])} "
+                f"compiles={p['compiles']} "
+                f"({_fmt_seconds(p['compile_s'])}) rows={p['rows']} "
+                f"pad={p['pad_rows']} rungs={p['bucket_rungs']}"
+            )
+            frac = ""
+            if p["flops_frac_of_peak"] is not None:
+                frac = f" ({p['flops_frac_of_peak'] * 100:.1f}% of peak)"
+            rr = p["residual_ratio"]
+            lines.append(
+                "    modeled "
+                f"{_fmt_rate(p['modeled_flops_per_exec'], 'FLOP')}/exec, "
+                f"{_fmt_bytes(p['modeled_bytes_per_exec'])}/exec | "
+                "achieved "
+                f"{_fmt_rate(p['achieved_flops_s'], 'FLOP/s')}, "
+                f"{_fmt_rate(p['achieved_hbm_bytes_s'], 'B/s')}{frac}"
+                + (f" | residual={rr:.2f}x" if rr is not None else "")
+            )
+    return "\n".join(lines)
+
+
+def explain_analyze(plan, format: str = "text"):
+    """EXPLAIN ANALYZE for a lazy plan: EXECUTE it and render each
+    stage with what actually happened — observed wall time per span
+    group, rows and bucket-rung pad waste per dispatch, device
+    placements, compile counts — side-by-side with the cost ledger's
+    modeled flops/HBM bytes and achieved rates for every program the
+    execution touched (`runtime.costmodel`), plus the cost-model
+    residual ratio per program.
+
+    ``plan`` is a `LazyFrame` (its pending chain is forced fresh —
+    the memoized result is deliberately bypassed so there is always a
+    real execution to measure) or any zero-argument callable running
+    tensorframes verbs (the way to analyze a chain ENDING in a reduce:
+    ``tfs.explain_analyze(lambda: lf.reduce_blocks(...))``); a
+    callable returning a LazyFrame is forced. A bare `LazyPlan` is
+    rejected — it is detached from its frame and cannot execute.
+
+    ``format="text"`` renders the report; ``format="json"`` returns
+    the machine-readable dict (same payload, the `diagnostics_data`
+    pattern). Requires ``config.telemetry`` (the span ring IS the
+    measurement). Attribution covers everything recorded during the
+    execution window on any thread — run it without concurrent verb
+    traffic for a clean read."""
+    from .utils import telemetry as _tele
+
+    if format not in ("text", "json"):
+        raise ValueError(
+            f"explain_analyze format={format!r} is not one of "
+            "'text' | 'json'"
+        )
+    if not _tele.enabled():
+        raise RuntimeError(
+            "explain_analyze needs telemetry: the span ring is the "
+            "measurement (config.update(telemetry=True) / TFS_TELEMETRY=1)"
+        )
+    if isinstance(plan, LazyPlan):
+        raise TypeError(
+            "explain_analyze cannot execute a bare LazyPlan (it is "
+            "detached from its frame); pass the LazyFrame itself or a "
+            "callable running the terminal action"
+        )
+    plan_obj: Optional[LazyPlan] = None
+    if isinstance(plan, LazyFrame):
+        plan_obj = plan.plan()
+        fresh = LazyFrame(
+            plan._base, plan._graph, plan._sources, plan._feed_map,
+            plan._stages, plan._executor, plan._mesh, plan._devices,
+        )
+        action = fresh.force
+    elif callable(plan):
+        action = plan
+    else:
+        raise TypeError(
+            "explain_analyze wants a LazyFrame or a callable, got "
+            f"{type(plan).__name__}"
+        )
+    import time as _time
+
+    sid0 = _tele.allocate_span_id()  # monotonic floor for window spans
+    dropped0 = _tele.spans_dropped()
+    t0 = _time.perf_counter()
+    result = action()
+    if isinstance(result, LazyFrame):
+        plan_obj = result.plan()
+        result = result.force()
+    # drain the async tail INSIDE the window (dispatch spans measure
+    # issue time; the device finishing its queue is part of the plan's
+    # wall clock and records as a host_sync stage)
+    try:
+        import jax
+
+        with _tele.span("explain_analyze.sync", kind="host_sync"):
+            jax.block_until_ready(result)
+    except Exception:
+        pass
+    wall_s = _time.perf_counter() - t0
+    new = [s for s in _tele.spans() if s.span_id > sid0]
+    data = _analyze_window(new, wall_s, _tele.spans_dropped() - dropped0)
+    if plan_obj is not None:
+        data["plan"] = {
+            "stages": [
+                {
+                    "verb": st.verb,
+                    "outputs": list(st.outputs),
+                    "nodes": st.nodes,
+                }
+                for st in plan_obj.stages
+            ],
+            "nodes": len(plan_obj.graph),
+            "feeds": dict(plan_obj.feeds),
+            "outputs": sorted(plan_obj.sources),
+        }
+    else:
+        data["plan"] = None
+    if format == "json":
+        return data
+    return _render_explain_analyze(data)

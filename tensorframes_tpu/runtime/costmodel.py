@@ -48,11 +48,13 @@ __all__ = [
     "capture",
     "note_exec",
     "program_costs",
+    "program_shapes",
     "program_footprint",
     "verb_peaks",
     "device_peaks",
     "memory_overview",
     "roofline",
+    "residuals",
     "reset",
 ]
 
@@ -357,6 +359,33 @@ def program_costs() -> Dict[str, Dict]:
     return out
 
 
+def program_shapes() -> Dict[str, List[Dict]]:
+    """Per-(program, kind, shape) ledger detail: one row per captured
+    shape entry with its lead row count (the BUCKET rows of a padded
+    dispatch — what joins against dispatch-span ``bucket``/``rows``
+    labels), exec count and modeled costs. The workload profiler and
+    the residual join read this; `program_costs` stays the aggregated
+    view."""
+    with _lock:
+        return {
+            fp: [
+                {
+                    "kind": kind,
+                    "rows": ent["rows"],
+                    "execs": ent["execs"],
+                    "flops": ent["flops"],
+                    "bytes_accessed": ent["bytes_accessed"],
+                    "arg_bytes": ent["arg_bytes"],
+                    "out_bytes": ent["out_bytes"],
+                    "temp_bytes": ent["temp_bytes"],
+                    "phase": ent["phase"],
+                }
+                for (kind, _sig_), ent in p["shapes"].items()
+            ]
+            for fp, p in _programs.items()
+        }
+
+
 def program_footprint(fp: str) -> Optional[Dict]:
     """The modeled footprint of one program fingerprint (for OOM
     forensics): max over captured shapes of argument + output (+ temp
@@ -538,6 +567,221 @@ def roofline(by_program: Dict[str, Dict]) -> List[Dict]:
     return rows
 
 
+# ---------------------------------------------------------------------------
+# cost-model accuracy: modeled vs span-achieved residuals
+# ---------------------------------------------------------------------------
+
+
+def residuals(span_list=None) -> Dict:
+    """How wrong is the cost model, per (program x dispatched shape)?
+
+    Joins the span ring's per-dispatch achieved seconds (grouped by
+    (program fingerprint, dispatched lead rows — the bucket rung of a
+    padded dispatch)) with the ledger's modeled cost for that same
+    shape, then scores each group against a PREDICTED time. Predictions
+    use a per-process *effective throughput* fitted over every joined
+    group (Σ modeled bytes / Σ achieved seconds, and the flops
+    analogue), so the residual is RELATIVE: ``residual_ratio`` = 1
+    means the program's achieved time sits exactly where the model's
+    cost ranks it among its peers; a ratio far from 1 means the model
+    misprices this program relative to the rest of the workload — the
+    signal a cost-based planner needs before trusting plan prices.
+    With a single joined group the fit is exact by construction
+    (ratio 1.0); accuracy needs workload diversity, honestly so.
+
+    When datasheet peaks are known (TPU), each group also carries the
+    ABSOLUTE roofline time (``modeled_peak_s``) and its ratio; on
+    peak-less backends those read None rather than invented.
+
+    Dispatch spans measure async issue windows (the documented span
+    caveat), so achieved seconds are a floor on sync-bound chains.
+    Returns ``{"warn_ratio", "fit", "groups", "programs"}``; programs
+    whose ratio exceeds ``config.cost_residual_warn_ratio`` (either
+    direction) are ``flagged``."""
+    from .. import config as _config
+    from ..utils import telemetry as _tele
+
+    ss = _tele.spans() if span_list is None else span_list
+    # achieved: (program, dispatched lead rows) -> seconds / count
+    groups: Dict[Tuple[str, Optional[int]], Dict] = {}
+    for s in ss:
+        if s.kind != "dispatch":
+            continue
+        prog = s.attrs.get("program")
+        if not prog:
+            continue
+        rows = s.attrs.get("rows")
+        bucket = s.attrs.get("bucket")
+        lead = bucket if bucket is not None else rows
+        lead = int(lead) if lead is not None else None
+        g = groups.setdefault(
+            (str(prog), lead),
+            {"seconds": 0.0, "dispatches": 0, "rows": 0.0},
+        )
+        g["seconds"] += s.seconds
+        g["dispatches"] += 1
+        g["rows"] += float(rows or 0)
+    # first-call XLA shape specializations happen INSIDE the dispatch
+    # window (jit compiles on call), so a program's compile spans are
+    # subtracted from its achieved dispatch seconds — the residual
+    # scores the model against steady-state execution, not against a
+    # one-off compile the model never claimed to price. The subtraction
+    # distributes proportionally across the program's shape groups and
+    # floors at 1% of the raw window (a wholly-compile-bound window
+    # still yields a finite, pessimistic-but-not-zero achieved time).
+    compile_s: Dict[str, float] = {}
+    for s in ss:
+        if s.kind == "compile":
+            prog = s.attrs.get("program")
+            if prog:
+                fp = str(prog)
+                compile_s[fp] = compile_s.get(fp, 0.0) + s.seconds
+    prog_disp_s: Dict[str, float] = {}
+    for (fp, _lead), g in groups.items():
+        prog_disp_s[fp] = prog_disp_s.get(fp, 0.0) + g["seconds"]
+    for (fp, _lead), g in groups.items():
+        cs = compile_s.get(fp, 0.0)
+        tot = prog_disp_s.get(fp, 0.0)
+        if cs > 0 and tot > 0:
+            g["compile_s_excluded"] = cs * (g["seconds"] / tot)
+            g["seconds"] = max(
+                0.01 * g["seconds"], g["seconds"] - g["compile_s_excluded"]
+            )
+    shapes = program_shapes()
+
+    def _modeled(fp: str, lead: Optional[int]) -> Tuple:
+        ents = shapes.get(fp) or []
+        match = [e for e in ents if e["rows"] == lead]
+        if not match and len(ents) == 1:
+            match = ents  # one captured shape: the only candidate
+        if not match:
+            return None, None
+        e = max(match, key=lambda e: e["execs"])
+        by = e["bytes_accessed"]
+        if by is None and e["arg_bytes"] is not None:
+            by = e["arg_bytes"] + (e["out_bytes"] or 0)
+        return e["flops"], by
+
+    joined = []
+    for (fp, lead), g in groups.items():
+        flops, by = _modeled(fp, lead)
+        joined.append(
+            {
+                "program": fp,
+                "rows": lead,
+                "dispatches": g["dispatches"],
+                "achieved_s": g["seconds"],
+                "compile_s_excluded": g.get("compile_s_excluded", 0.0),
+                "modeled_flops": flops,
+                "modeled_bytes": by,
+            }
+        )
+    fit_b_num = fit_b_den = fit_f_num = fit_f_den = 0.0
+    for r in joined:
+        if r["achieved_s"] <= 0:
+            continue
+        if r["modeled_bytes"] is not None:
+            fit_b_num += r["modeled_bytes"] * r["dispatches"]
+            fit_b_den += r["achieved_s"]
+        if r["modeled_flops"] is not None:
+            fit_f_num += r["modeled_flops"] * r["dispatches"]
+            fit_f_den += r["achieved_s"]
+    eff_bytes = fit_b_num / fit_b_den if fit_b_den > 0 else None
+    eff_flops = fit_f_num / fit_f_den if fit_f_den > 0 else None
+    peaks = device_peaks()
+    warn = float(
+        getattr(_config.get(), "cost_residual_warn_ratio", 0.0) or 0.0
+    )
+    per_prog: Dict[str, Dict] = {}
+    for r in joined:
+        pred = None
+        # prefer the bytes model (dataframe verbs are bandwidth-shaped);
+        # flops is the fallback when bytes never captured
+        if r["modeled_bytes"] is not None and eff_bytes:
+            pred = r["modeled_bytes"] / eff_bytes
+        elif r["modeled_flops"] is not None and eff_flops:
+            pred = r["modeled_flops"] / eff_flops
+        r["predicted_s_per_exec"] = pred
+        ach = (
+            r["achieved_s"] / r["dispatches"] if r["dispatches"] else None
+        )
+        r["achieved_s_per_exec"] = ach
+        r["residual_ratio"] = (
+            ach / pred if (pred and ach is not None and pred > 0) else None
+        )
+        peak_s = None
+        if r["modeled_flops"] is not None and peaks["matmul_flops_s"]:
+            peak_s = r["modeled_flops"] / peaks["matmul_flops_s"]
+        if r["modeled_bytes"] is not None and peaks["hbm_bytes_s"]:
+            hb = r["modeled_bytes"] / peaks["hbm_bytes_s"]
+            peak_s = hb if peak_s is None else max(peak_s, hb)
+        r["modeled_peak_s"] = peak_s
+        r["peak_ratio"] = (
+            ach / peak_s if (peak_s and ach is not None) else None
+        )
+        p = per_prog.setdefault(
+            r["program"],
+            {"achieved_s": 0.0, "predicted_s": 0.0, "dispatches": 0,
+             "worst_group_ratio": None},
+        )
+        p["dispatches"] += r["dispatches"]
+        if pred is not None:
+            p["achieved_s"] += r["achieved_s"]
+            p["predicted_s"] += pred * r["dispatches"]
+            rr = r["residual_ratio"]
+            if rr is not None and (
+                p["worst_group_ratio"] is None
+                or abs(_log2(rr)) > abs(_log2(p["worst_group_ratio"]))
+            ):
+                p["worst_group_ratio"] = rr
+    for fp, p in per_prog.items():
+        ratio = (
+            p["achieved_s"] / p["predicted_s"]
+            if p["predicted_s"] > 0
+            else None
+        )
+        p["residual_ratio"] = ratio
+        p["flagged"] = bool(
+            warn > 0
+            and ratio is not None
+            and (ratio > warn or ratio < 1.0 / warn)
+        )
+    return {
+        "warn_ratio": warn,
+        "fit": {
+            "bytes_per_s": eff_bytes,
+            "flops_per_s": eff_flops,
+            "groups": len(joined),
+        },
+        "groups": sorted(
+            joined, key=lambda r: (r["program"], r["rows"] or 0)
+        ),
+        "programs": per_prog,
+    }
+
+
+def _log2(x: float) -> float:
+    import math
+
+    return math.log2(x) if x > 0 else 0.0
+
+
+def _register_residual_gauge() -> None:
+    """``costmodel_residual{program=}``: the per-program residual ratio
+    as a registered gauge family — evaluated only at export (a scrape
+    walks the span ring once, same cost class as /diagnostics)."""
+    from ..utils import telemetry as _tele
+
+    def _residual() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for fp, p in residuals()["programs"].items():
+            if p.get("residual_ratio") is not None:
+                out[fp] = float(p["residual_ratio"])
+        return out
+
+    _tele.gauge_register_multi("costmodel_residual", "program", _residual)
+
+
 def reset() -> None:
     """Clear the ledger and verb peaks (test isolation — the conftest
     autouse fixture calls this beside `telemetry.reset()`)."""
@@ -547,3 +791,4 @@ def reset() -> None:
 
 
 _register_gauges()
+_register_residual_gauge()

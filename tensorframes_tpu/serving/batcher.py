@@ -339,6 +339,19 @@ class MicroBatcher:
             # shape_bucketing knob; the pad tail is sliced off with the
             # scatter below
             rung = _sp.bucket_for(rows)
+            # serving's own bucket-economics signal: fill fraction of
+            # the rung this coalesced batch pads to, labeled per
+            # endpoint (the batch-window autotuner reads it next to
+            # serve_batch_rows/serve_queue_seconds). Observed ONLY for
+            # rung-shaped dispatches — an oversized request dispatches
+            # unpadded at its exact shape, so there is no rung fill to
+            # report (the inner verb's own pad accounting covers it).
+            # NB the padded frame below dispatches exactly on a rung,
+            # so the inner verb records fill=1.0 under its OWN label —
+            # true by construction: serving absorbs the pad waste here
+            # and the map-level dispatch genuinely wastes nothing.
+            if rows == rung or (rung > rows and rows <= ep.max_batch_rows):
+                _sp.observe_fill(rows, rung, verb=f"serve:{ep.name}")
             if rung > rows and rows <= ep.max_batch_rows:
                 padded = TensorFrame(
                     [

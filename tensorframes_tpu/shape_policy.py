@@ -57,6 +57,7 @@ __all__ = [
     "bucket_for",
     "bucket_ladder",
     "enabled",
+    "observe_fill",
     "pad_feeds",
     "pad_lead",
     "slice_pad_rows",
@@ -152,11 +153,30 @@ def pad_lead(a, n: int, bucket: int):
     return np.concatenate([a, np.broadcast_to(a[-1:], rep)])
 
 
+def observe_fill(n: int, bucket: int, verb: Optional[str] = None) -> None:
+    """Record one bucketed dispatch's fill fraction (valid rows /
+    rung rows) into the ``bucket_fill{verb=}`` histogram — exact-rung
+    hits observe 1.0, so the distribution is the honest per-verb
+    bucket-economics signal the workload profile and the future ladder
+    autotuner consume. Gated on the telemetry master switch like every
+    histogram; the verb label rides the ambient verb span."""
+    from .utils import telemetry as _tele
+
+    if bucket <= 0 or not _tele.enabled():
+        return
+    if verb is None:
+        verb = _tele.current_verb() or "unattributed"
+    _tele.histogram_observe(
+        "bucket_fill", min(1.0, n / bucket), verb=verb
+    )
+
+
 def pad_feeds(feeds: Sequence, n: int) -> Tuple[List, int]:
     """Pad every feed's lead dim up to ``n``'s bucket. Returns
     ``(padded_feeds, bucket)``; when ``bucket == n`` the feeds pass
     through untouched (the already-on-a-rung fast path)."""
     b = bucket_for(n)
+    observe_fill(n, b)
     if b == n:
         return list(feeds), n
     from .utils.profiling import count as _count
@@ -188,6 +208,7 @@ def pad_mesh_shards(frame, cols_used: Sequence[str], ndev: int):
     remainder-tail program disappears. Returns ``(main, tail,
     shard_rows, shard_valids)``; ``tail`` is empty by construction."""
     s, valids = mesh_shard_plan(frame.nrows, ndev)
+    observe_fill(frame.nrows, s * ndev)
     main = {
         c: pad_lead(frame.column(c).values, frame.nrows, s * ndev)
         for c in set(cols_used)

@@ -51,19 +51,27 @@ def count(key: str, value: float = 1.0) -> None:
 def record(verb: str, rows: int = 0):
     """Time one verb invocation: bump the legacy counters, and — when
     telemetry is on — record a ``verb`` span and observe the per-verb
-    latency histogram."""
-    with _tele.span(verb, kind="verb", rows=rows or None):
-        t0 = time.perf_counter()
-        try:
+    latency histogram. There is ONE clock: the span's own ``t0``/``t1``
+    pair also feeds the ``.seconds`` counter and the histogram, so the
+    span in the ring and the metrics derived from the same call can
+    never disagree (they used to ride two separate `perf_counter`
+    pairs). The fallback pair below is read only when telemetry is off
+    — no span is recorded then, so there is nothing to disagree with."""
+    ctx = _tele.span(verb, kind="verb", rows=rows or None)
+    t0 = time.perf_counter()
+    try:
+        with ctx:
             yield
-        finally:
+    finally:
+        dt = getattr(ctx, "seconds", None)
+        if dt is None:  # disabled telemetry: the shared no-op context
             dt = time.perf_counter() - t0
-            _tele.counter_inc(f"{verb}.calls")
-            _tele.counter_inc(f"{verb}.seconds", dt)
-            if rows:
-                _tele.counter_inc(f"{verb}.rows", rows)
-            if _tele.enabled():
-                _tele.histogram_observe("verb_seconds", dt, verb=verb)
+        _tele.counter_inc(f"{verb}.calls")
+        _tele.counter_inc(f"{verb}.seconds", dt)
+        if rows:
+            _tele.counter_inc(f"{verb}.rows", rows)
+        if _tele.enabled():
+            _tele.histogram_observe("verb_seconds", dt, verb=verb)
 
 
 @contextlib.contextmanager

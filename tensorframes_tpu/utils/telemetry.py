@@ -63,6 +63,7 @@ __all__ = [
     "span_aggregates",
     "metrics_snapshot",
     "flat_counters",
+    "labeled_counters",
     "export_chrome_trace",
     "export_prometheus",
     "diagnostics",
@@ -215,7 +216,7 @@ class _SpanCtx:
 
     __slots__ = (
         "name", "kind", "attrs", "sid", "parent", "tok", "ann", "t0",
-        "ptok", "program", "vtok",
+        "t1", "ptok", "program", "vtok",
     )
 
     def __init__(self, name, kind, attrs, program=None):
@@ -246,8 +247,16 @@ class _SpanCtx:
         self.t0 = time.perf_counter()
         return self.sid
 
+    @property
+    def seconds(self) -> float:
+        """Duration on the SPAN's clock, valid after exit — the one
+        timing source `utils.profiling.record` re-uses for its
+        counters and the `verb_seconds` histogram, so a verb's span
+        and its histogram observation can never disagree."""
+        return self.t1 - self.t0
+
     def __exit__(self, et, ev, tb):
-        t1 = time.perf_counter()
+        t1 = self.t1 = time.perf_counter()
         if self.ann is not None:
             self.ann.__exit__(None, None, None)
         if self.ptok is not None:
@@ -439,7 +448,10 @@ def _label_key(labels: Dict[str, object]) -> LabelItems:
 
 
 # fixed bucket ladders per histogram family — fixed (not adaptive) so
-# concurrent observers never re-bucket and exports are stable
+# concurrent observers never re-bucket and exports are stable. These
+# defaults are part of the exposition contract (tests pin them);
+# operators re-shape a ladder via ``config.histogram_buckets`` /
+# TFS_HISTOGRAM_BUCKETS instead of editing this table.
 _DEFAULT_BUCKETS: Dict[str, Tuple[float, ...]] = {
     "seconds": (
         1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2,
@@ -453,6 +465,11 @@ _DEFAULT_BUCKETS: Dict[str, Tuple[float, ...]] = {
         256.0, 4096.0, 65536.0, 1048576.0, 16777216.0, 268435456.0,
         4294967296.0,
     ),
+    # 0..1 ratios (bucket fill fractions): resolution concentrated near
+    # full, where the ladder autotuner's decisions live
+    "fraction": (
+        0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0,
+    ),
 }
 
 # histogram name -> bucket family
@@ -462,7 +479,36 @@ _HISTOGRAM_FAMILIES: Dict[str, str] = {
     "block_rows": "rows",
     "h2d_bytes": "bytes",
     "d2h_bytes": "bytes",
+    "bucket_fill": "fraction",
+    # serving batch economics: row/request counts were previously
+    # bucketed on the implicit "seconds" ladder (topping out at 30),
+    # which parked every real observation in the +Inf overflow bucket
+    # and made their quantiles unreadable
+    "serve_batch_rows": "rows",
+    "serve_batch_fill": "rows",
 }
+
+
+def _buckets_for(name: str) -> Tuple[float, ...]:
+    """Bucket boundaries for a histogram about to be created: the
+    ``config.histogram_buckets`` override (exact metric name wins over
+    its bucket family), validated ascending, else the built-in family
+    default. A malformed override silently falls back — a bad config
+    value must never turn an observation into an exception."""
+    fam = _HISTOGRAM_FAMILIES.get(name, "seconds")
+    try:
+        from .. import config as _config
+
+        over = getattr(_config.get(), "histogram_buckets", None)
+        if over:
+            raw = over.get(name, over.get(fam))
+            if raw:
+                b = tuple(float(x) for x in raw)
+                if b and all(x < y for x, y in zip(b, b[1:])):
+                    return b
+    except Exception:
+        pass
+    return _DEFAULT_BUCKETS[fam]
 
 
 class _Histogram:
@@ -573,8 +619,7 @@ class MetricsRegistry:
         with self._lock:
             h = self._histograms.get(key)
             if h is None:
-                fam = _HISTOGRAM_FAMILIES.get(name, "seconds")
-                h = _Histogram(_DEFAULT_BUCKETS[fam])
+                h = _Histogram(_buckets_for(name))
                 self._histograms[key] = h
             h.observe(float(value))
 
@@ -625,6 +670,14 @@ def histogram_observe(name: str, value: float, **labels) -> None:
 
 def flat_counters() -> Dict[str, float]:
     return _registry.flat_counters()
+
+
+def labeled_counters() -> Dict[Tuple[str, LabelItems], float]:
+    """Structured counter snapshot keyed ``(name, ((label, value),
+    ...))`` — what the workload profiler aggregates from (the flat view
+    stringifies labels, which cannot be re-keyed reliably)."""
+    with _registry._lock:
+        return dict(_registry._counters)
 
 
 def metrics_snapshot():
@@ -898,6 +951,10 @@ _PROM_HELP: Dict[str, str] = {
     "ingest_stage_wait_seconds": "Ingest stage starved time",
     "verb_seconds": "Verb call latency",
     "compile_seconds": "Compile time by program and phase",
+    "bucket_fill": "Valid-row fraction of each bucketed dispatch by verb",
+    "costmodel_residual": (
+        "Span-achieved vs cost-model-predicted time ratio per program"
+    ),
     "block_rows": "Rows per block dispatch",
     "h2d_bytes": "Host-to-device transfer bytes",
     "d2h_bytes": "Device-to-host transfer bytes",
@@ -1047,6 +1104,43 @@ def diagnostics_data(executor=None) -> Dict:
         }
     except Exception as e:
         data["cost"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # cost-model accuracy: modeled vs span-achieved residuals -----------
+    try:
+        from ..runtime import costmodel as _cm
+
+        data["accuracy"] = _cm.residuals(ss)
+    except Exception as e:
+        data["accuracy"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # bucketing pad waste + fill fractions ------------------------------
+    try:
+        counters = flat_counters()
+        fill: Dict[str, Dict] = {}
+        for (name, labels), (
+            _b, _c, hsum, hcount,
+        ) in _registry.histogram_snapshot().items():
+            if name != "bucket_fill" or not hcount:
+                continue
+            verb = dict(labels).get("verb", "unattributed")
+            f = fill.setdefault(verb, {"sum": 0.0, "count": 0})
+            f["sum"] += hsum
+            f["count"] += hcount
+        data["bucketing"] = {
+            "padded_dispatches": int(
+                counters.get("shape_bucketing.padded_dispatch", 0)
+            ),
+            "pad_rows": int(counters.get("shape_bucketing.pad_rows", 0)),
+            "fill": {
+                v: {
+                    "mean": f["sum"] / f["count"],
+                    "dispatches": f["count"],
+                }
+                for v, f in sorted(fill.items())
+            },
+        }
+    except Exception as e:
+        data["bucketing"] = {"error": f"{type(e).__name__}: {e}"}
 
     # per-device memory -------------------------------------------------
     try:
@@ -1234,6 +1328,49 @@ def _render_diagnostics(data: Dict) -> str:
                     f"rows={pk['rows']})"
                 )
 
+    # cost-model accuracy ----------------------------------------------
+    acc = data.get("accuracy", {})
+    if acc.get("programs"):
+        warn = acc.get("warn_ratio")
+        fit = acc.get("fit", {})
+        lines.append("")
+        lines.append(
+            "cost-model accuracy (achieved vs predicted per dispatch; "
+            "predictions from the process-fitted effective throughput "
+            f"{_fmt_rate(fit.get('bytes_per_s'), 'B/s')} / "
+            f"{_fmt_rate(fit.get('flops_per_s'), 'FLOP/s')}; "
+            f"flag threshold x{warn:g}):"
+        )
+        for fp, p in sorted(
+            acc["programs"].items(),
+            key=lambda kv: -(kv[1]["residual_ratio"] or 0.0),
+        ):
+            ratio = p["residual_ratio"]
+            if ratio is None:
+                continue
+            flag = "  ** MODEL MISPRICES THIS PROGRAM" if p["flagged"] else ""
+            lines.append(
+                f"  {fp:<16} residual={ratio:.2f}x "
+                f"({p['dispatches']} dispatch(es), "
+                f"achieved {p['achieved_s']:.4f}s vs predicted "
+                f"{p['predicted_s']:.4f}s){flag}"
+            )
+
+    # bucketing pad waste ----------------------------------------------
+    bk = data.get("bucketing", {})
+    if bk.get("padded_dispatches") or bk.get("fill"):
+        lines.append("")
+        lines.append(
+            f"bucketing: {bk.get('padded_dispatches', 0)} padded "
+            f"dispatch(es), {bk.get('pad_rows', 0)} pad row(s) "
+            "(synthetic rows paid for the bounded compile count)"
+        )
+        for verb, f in bk.get("fill", {}).items():
+            lines.append(
+                f"  fill[{verb}]: mean={f['mean']:.3f} over "
+                f"{f['dispatches']} bucketed dispatch(es)"
+            )
+
     # fault tolerance: device health + the fault ledger -----------------
     if "faults_error" in data:
         lines.append(
@@ -1328,8 +1465,9 @@ def _render_diagnostics(data: Dict) -> str:
 def serve(port: Optional[int] = None, host: Optional[str] = None):
     """Start the live telemetry HTTP endpoint (`utils.telemetry_http`):
     ``/metrics`` (Prometheus text), ``/healthz`` (device-health JSON),
-    ``/diagnostics`` (JSON) and ``/trace`` (Chrome trace JSON) on a
-    daemon thread. ``port`` defaults to ``config.telemetry_port``
+    ``/diagnostics`` (JSON), ``/trace`` (Chrome trace JSON) and
+    ``/profile`` (a live workload-profile snapshot) on a daemon
+    thread. ``port`` defaults to ``config.telemetry_port``
     (``TFS_TELEMETRY_PORT``); pass ``port=0`` for an ephemeral port.
     Binds ``config.telemetry_host`` (127.0.0.1 by default — the
     endpoint has no auth). Returns the `TelemetryServer` handle

@@ -15,6 +15,9 @@ its autoscaling signal source. Four routes:
   the body, not the code.
 - ``/diagnostics`` — the `diagnostics_data` JSON payload.
 - ``/trace`` — the span ring as Chrome trace JSON (load in Perfetto).
+- ``/profile`` — a live `runtime.profiler.snapshot()` of the workload
+  profile (the same JSON `WorkloadProfile.save` writes — scrape it to
+  persist a running service's profile without touching the process).
 
 Concurrency: `ThreadingHTTPServer` (one thread per in-flight scrape)
 over registries that already snapshot under their own locks, so eight
@@ -201,13 +204,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(_tele.diagnostics_data())
             elif path == "/trace":
                 self._send_json(_tele.export_chrome_trace())
+            elif path == "/profile":
+                from ..runtime import profiler as _prof
+
+                self._send_json(
+                    _prof.snapshot(note="telemetry_http:/profile")
+                    .to_dict()
+                )
             elif path == "/":
                 self._send_json(
                     {
                         "service": "tensorframes_tpu telemetry",
                         "routes": [
                             "/metrics", "/healthz", "/diagnostics",
-                            "/trace",
+                            "/trace", "/profile",
                         ] + sorted(mounts()),
                     }
                 )

@@ -1,0 +1,430 @@
+"""Closed-loop autotuner bench: each policy beats its static default.
+
+The ISSUE-12 tentpole claim: the feedback controller in
+`runtime.autotune` — pure ``observations -> recommendation`` policies
+over the live `WorkloadProfile`, applied through the pin-respecting
+tuned-config layer — beats the hand-set defaults on adversarial
+workloads, without changing any result:
+
+- **Bucket ladder** (always asserted): a workload whose block sizes
+  cluster just above a geometric-ladder rung pads away >= 30% of every
+  dispatch under the static growth-2 ladder. The closed loop (run ->
+  snapshot -> recommend -> apply, repeated until the fill signal rests
+  in the dead band) shrinks the growth until fill recovers; the tuned
+  ladder must be >= 1.2x faster wall-clock on the steady-state
+  (warm-compiled) workload, with map/min bit-identical and sum within
+  the documented float tolerance. Self-gates only when the static pass
+  is too fast to time honestly (dispatch-overhead-bound smoke hosts).
+- **Ingest workers/depth**: a decode-bound stream (every decode
+  attempt throttled by a deterministic injected delay — the I/O-bound
+  shard-fetch regime) starves compute under the defaults; the loop
+  reads the per-stage busy/starvation counters, widens the decode pool
+  (and deepens the delivery queue to match), and the tuned stream must
+  be >= 1.2x faster with identical reduce results. Self-gates when the
+  pipeline is off or the policy could not move the knob.
+- **Serving window + admission limit**: policy-direction checks on
+  synthetic profiles (shrink under shed/deadline pressure, widen with
+  coalescing + p99 headroom; raise the limit on shed-without-
+  saturation, cap at the observed peak under roofline saturation) —
+  deterministic, asserted unconditionally; the wall-clock legs for
+  these two knobs need sustained concurrent traffic that a CI smoke
+  host cannot generate honestly.
+
+Sizes: AUTOTUNE_BLOCKS x AUTOTUNE_BASE(+AUTOTUNE_SPREAD) clustered
+block rows x AUTOTUNE_CELLS cells, AUTOTUNE_ITERS timed passes;
+AUTOTUNE_SHARDS x AUTOTUNE_GROUPS x AUTOTUNE_GROUP_ROWS parquet
+stream with AUTOTUNE_DECODE_MS of injected decode latency per chunk.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _util import emit, scaled  # noqa: E402
+
+
+def _tune_until_quiet(cycles, probe):
+    """The closed loop: probe the workload, snapshot, recommend, apply
+    — until a cycle applies nothing (the signal rests in a dead band)
+    or the cycle budget runs out. Returns the applied decisions."""
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.runtime import costmodel
+    from tensorframes_tpu.utils import telemetry
+
+    applied = []
+    for _ in range(cycles):
+        telemetry.reset()
+        costmodel.reset()
+        probe()
+        res = tfs.autotune()
+        moved = [d for d in res["applied"] if d["outcome"] == "applied"]
+        applied += moved
+        if not moved:
+            break
+    return applied
+
+
+def ladder_leg():
+    import jax
+
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu import shape_policy as sp
+    from tensorframes_tpu.runtime.executor import Executor
+
+    blocks = scaled("AUTOTUNE_BLOCKS", 16)
+    base = scaled("AUTOTUNE_BASE", 35_000)
+    spread = scaled("AUTOTUNE_SPREAD", 4_000)
+    cells = scaled("AUTOTUNE_CELLS", 16)
+    iters = scaled("AUTOTUNE_ITERS", 3)
+    cycles = scaled("AUTOTUNE_CYCLES", 4)
+
+    # clustered-but-distinct block sizes just above a growth-2 rung:
+    # the adversarial regime where the geometric default pads worst
+    sizes = [base + (i * 37) % spread for i in range(blocks)]
+    nrows = sum(sizes)
+    static_growth = config.default_value("shape_bucket_growth")
+    static_fill = float(np.mean(
+        [s / sp.bucket_for(s, growth=static_growth, min_bucket=8)
+         for s in sizes]
+    ))
+    assert static_fill <= 0.70, (
+        f"adversarial workload must waste >= 30% under the static "
+        f"ladder, got mean fill {static_fill:.3f} — pick AUTOTUNE_BASE "
+        "just above a growth-2 rung"
+    )
+
+    offsets = list(np.cumsum([0] + sizes))
+    data = (
+        np.arange(nrows * cells, dtype=np.float32).reshape(nrows, cells)
+        % 251.0
+    )
+    df = tfs.TensorFrame.from_dict({"x": data})
+    df = tfs.TensorFrame([df["x"]], offsets)
+
+    def workload(ex):
+        x = tfs.block(df, "x")
+        # a rowwise-but-not-free chain: transcendentals make pad rows
+        # cost real time, so fill economics show up in wall clock
+        y = (dsl.tanh(x * 0.5) * 2.0 + dsl.tanh(x * 0.25) + x).named("y")
+        mapped = tfs.map_blocks(y, df, executor=ex)
+        red = dsl.reduce_sum(
+            tfs.block(df, "x", tf_name="s_input"), axes=[0]
+        ).named("s")
+        mn = dsl.reduce_min(
+            tfs.block(df, "x", tf_name="mn_input"), axes=[0]
+        ).named("mn")
+        return {
+            "map": np.asarray(mapped["y"].values),
+            "sum": np.asarray(tfs.reduce_blocks(
+                red, df, feed_dict={"s_input": "x"}, executor=ex
+            )),
+            "min": np.asarray(tfs.reduce_blocks(
+                mn, df, feed_dict={"mn_input": "x"}, executor=ex
+            )),
+        }
+
+    def timed(ex):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = workload(ex)
+            jax.block_until_ready(out["sum"])
+        return time.perf_counter() - t0
+
+    # -- static default: correctness reference + steady-state timing ----
+    ex_static = Executor()
+    ref = workload(ex_static)  # warm: compiles stay out of the timing
+    dt_static = timed(ex_static)
+
+    # -- the closed loop ------------------------------------------------
+    probe_ex = Executor()
+    applied = _tune_until_quiet(cycles, lambda: workload(probe_ex))
+    growth_moves = [
+        d for d in applied if d["knob"] == "shape_bucket_growth"
+    ]
+    assert growth_moves, (
+        "the ladder policy must shrink shape_bucket_growth on a "
+        f"clustered workload with mean fill {static_fill:.3f}"
+    )
+    tuned_growth = config.get().shape_bucket_growth
+    assert tuned_growth < static_growth, (
+        f"tuned growth {tuned_growth} should be below the static "
+        f"{static_growth}"
+    )
+    tuned_fill = float(np.mean(
+        [s / sp.bucket_for(s) for s in sizes]
+    ))
+
+    # -- tuned: same workload, same warm discipline ---------------------
+    ex_tuned = Executor()
+    got = workload(ex_tuned)  # warm the tuned ladder's rungs
+    dt_tuned = timed(ex_tuned)
+
+    assert np.array_equal(got["map"], ref["map"]), (
+        "tuned map output must be bit-identical to the static ladder's"
+    )
+    assert np.array_equal(got["min"], ref["min"]), (
+        "tuned min must be bit-identical to the static ladder's"
+    )
+    np.testing.assert_allclose(got["sum"], ref["sum"], rtol=1e-5)
+    emit("autotune ladder tuned-vs-static results identical", 1, "bool")
+
+    speedup = dt_static / dt_tuned
+    emit(
+        f"autotune ladder static growth={static_growth:g} "
+        f"(mean fill {static_fill:.2f}, {blocks} clustered blocks x "
+        f"~{base} rows x {cells} cells)",
+        round(nrows * iters / dt_static),
+        "rows/s",
+    )
+    emit(
+        f"autotune ladder tuned growth={tuned_growth:g} "
+        f"(mean fill {tuned_fill:.2f}, {len(growth_moves)} cycle(s))",
+        round(nrows * iters / dt_tuned),
+        "rows/s",
+    )
+    emit("autotune ladder speedup (tuned vs static)", round(speedup, 3), "x")
+    emit(
+        "autotune ladder pad-fill recovered (static -> tuned mean fill)",
+        round(tuned_fill - static_fill, 3),
+        "frac",
+    )
+    assert tuned_fill > static_fill + 0.1, (
+        f"tuned ladder must recover fill: {static_fill:.3f} -> "
+        f"{tuned_fill:.3f}"
+    )
+    if dt_static / iters >= 0.03:
+        assert speedup >= 1.2, (
+            f"tuned ladder should be >= 1.2x on a pad-dominated "
+            f"workload (fill {static_fill:.2f} -> {tuned_fill:.2f}), "
+            f"got {speedup:.3f}x"
+        )
+    else:
+        emit(
+            "autotune ladder speedup assertion skipped (static pass "
+            f"{dt_static / iters * 1e3:.1f}ms is dispatch-overhead-"
+            "bound at this size)",
+            0,
+            "bool",
+        )
+
+
+def ingest_leg():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu import io as tio
+    from tensorframes_tpu.testing import faults as tfaults
+
+    shards = scaled("AUTOTUNE_SHARDS", 6)
+    groups = scaled("AUTOTUNE_GROUPS", 2)
+    group_rows = scaled("AUTOTUNE_GROUP_ROWS", 4_000)
+    iters = scaled("AUTOTUNE_STREAM_ITERS", 3)
+    cycles = scaled("AUTOTUNE_CYCLES", 4)
+    delay_s = scaled("AUTOTUNE_DECODE_MS", 20) / 1e3
+    total_rows = shards * groups * group_rows
+
+    if not config.get().ingest_pipeline:
+        emit(
+            "autotune ingest leg skipped (config.ingest_pipeline off: "
+            "no stage overlap to tune)",
+            0,
+            "bool",
+        )
+        return
+
+    root = tempfile.mkdtemp(prefix="tfs_autotune_bench_")
+    try:
+        rng = np.random.RandomState(7)
+        parts = []
+        for i in range(shards):
+            x = rng.rand(groups * group_rows).astype(np.float32)
+            parts.append(x)
+            tio.write_parquet(
+                tfs.TensorFrame.from_dict({"x": x}, num_blocks=groups),
+                os.path.join(root, f"shard-{i:04d}.parquet"),
+            )
+        allx = np.concatenate(parts)
+        del parts
+
+        df0 = tfs.TensorFrame.from_dict({"x": allx[:2]})
+        fetches = [
+            dsl.reduce_sum(
+                tfs.block(df0, "x", tf_name="s_input"), axes=[0]
+            ).named("s"),
+            dsl.reduce_min(
+                tfs.block(df0, "x", tf_name="mn_input"), axes=[0]
+            ).named("mn"),
+        ]
+        feeds = {"s_input": "x", "mn_input": "x"}
+
+        def run_stream():
+            # every decode attempt pays a deterministic injected delay:
+            # the I/O-bound decode regime (slow shard storage) where
+            # in-flight decode width, not CPU count, sets throughput
+            with tfaults.inject_stage(
+                stage="decode", rate=1.0, fault="hang", delay_s=delay_s
+            ):
+                return tfs.reduce_blocks_stream(
+                    fetches, tfs.stream_dataset(root), feed_dict=feeds
+                )
+
+        def timed():
+            best, out = float("inf"), None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                out = run_stream()
+                _ = [np.asarray(v) for v in out.values()]
+                best = min(best, time.perf_counter() - t0)
+            return best, out
+
+        from tensorframes_tpu.runtime.autotune import (
+            _effective_decode_workers,
+        )
+
+        static_workers = _effective_decode_workers(
+            config.default_value("ingest_decode_workers")
+        )
+        static_depth = config.default_value("stream_prefetch_depth")
+
+        _ = run_stream()  # warm: chunk + combine programs compiled
+        dt_static, out_static = timed()
+
+        applied = _tune_until_quiet(cycles, run_stream)
+        worker_moves = [
+            d for d in applied if d["knob"] == "ingest_decode_workers"
+        ]
+        tuned_workers = config.get().ingest_decode_workers or static_workers
+        tuned_depth = config.get().stream_prefetch_depth
+
+        dt_tuned, out_tuned = timed()
+
+        assert float(out_tuned["mn"]) == float(out_static["mn"]), (
+            "tuned stream min must be bit-identical"
+        )
+        np.testing.assert_allclose(
+            float(out_tuned["s"]), float(out_static["s"]), rtol=1e-5
+        )
+
+        speedup = dt_static / dt_tuned
+        emit(
+            f"autotune ingest static ({static_workers} worker(s), "
+            f"depth {static_depth}; {shards * groups} chunks x "
+            f"{delay_s * 1e3:.0f}ms decode latency)",
+            round(total_rows / dt_static),
+            "rows/s",
+        )
+        emit(
+            f"autotune ingest tuned ({tuned_workers} worker(s), depth "
+            f"{tuned_depth}, {len(worker_moves)} cycle(s))",
+            round(total_rows / dt_tuned),
+            "rows/s",
+        )
+        emit(
+            "autotune ingest speedup (tuned vs static)",
+            round(speedup, 3),
+            "x",
+        )
+        if tuned_workers > static_workers:
+            assert speedup >= 1.2, (
+                f"widening the decode pool {static_workers} -> "
+                f"{tuned_workers} on a latency-bound stream should be "
+                f">= 1.2x, got {speedup:.3f}x"
+            )
+        else:
+            emit(
+                "autotune ingest speedup assertion skipped (policy did "
+                f"not widen the pool: {static_workers} -> "
+                f"{tuned_workers} worker(s))",
+                0,
+                "bool",
+            )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def policy_direction_leg():
+    """Deterministic direction checks for the serving-window and
+    admission policies on synthetic profiles (their wall-clock legs
+    need sustained concurrency a smoke host cannot produce honestly)."""
+    from tensorframes_tpu.runtime import autotune as at
+    from tensorframes_tpu.runtime.profiler import PROFILE_SCHEMA
+
+    def hist(buckets, counts, hsum, n):
+        return {"buckets": buckets, "counts": counts, "sum": hsum,
+                "count": n}
+
+    pressure = {
+        "schema": PROFILE_SCHEMA,
+        "serving": {
+            "endpoints": {"ep": {"requests": 64, "batches": 16, "shed": 3}},
+            "batch_requests": hist([1, 4, 16], [0, 16, 0, 0], 64, 16),
+            "queue_seconds": hist([0.1, 1.0], [0, 16, 0], 16.0, 16),
+        },
+    }
+    recs = at.serving_policy(pressure, window_ms=5.0, default_timeout_s=1.0)
+    assert recs and recs[0].proposed < 5.0, recs
+    headroom = {
+        "schema": PROFILE_SCHEMA,
+        "serving": {
+            "endpoints": {"ep": {"requests": 64, "batches": 16, "shed": 0}},
+            "batch_requests": hist([1, 4, 16], [0, 16, 0, 0], 64, 16),
+            "queue_seconds": hist([0.001, 0.01], [16, 0, 0], 0.016, 16),
+        },
+    }
+    recs = at.serving_policy(headroom, window_ms=5.0, default_timeout_s=30.0)
+    assert recs and recs[0].proposed > 5.0, recs
+
+    shed = {
+        "schema": PROFILE_SCHEMA,
+        "admission": {"admitted": 100, "shed": 8, "peak_in_flight": 2},
+        "residuals": {"peak_ratio_max": None},
+    }
+    recs = at.admission_policy(shed, limit=2)
+    assert recs and recs[0].proposed > 2, recs
+    saturated = {
+        "schema": PROFILE_SCHEMA,
+        "admission": {"admitted": 100, "shed": 0, "peak_in_flight": 3},
+        "residuals": {"peak_ratio_max": 0.8},
+    }
+    recs = at.admission_policy(saturated, limit=0)
+    assert recs and recs[0].proposed == 3, recs
+    emit(
+        "autotune policy direction checks "
+        "(serving shrink/widen, admission raise/cap)",
+        4,
+        "checks",
+    )
+
+
+def main():
+    from tensorframes_tpu import config
+
+    config.reset_tuning()
+    try:
+        ladder_leg()
+    finally:
+        config.reset_tuning()
+    try:
+        ingest_leg()
+    finally:
+        config.reset_tuning()
+    policy_direction_leg()
+
+
+if __name__ == "__main__":
+    # single-device bucket economics, like bucketing_bench: the ladder
+    # leg's compile/pad accounting must not fold in the scheduler's
+    # per-device jit specialization
+    import tensorframes_tpu as tfs
+
+    with tfs.config.override(block_scheduler="off"):
+        main()

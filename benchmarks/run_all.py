@@ -72,6 +72,15 @@ SMOKE_SIZES = {
     "SERVE_ROWS": "512",
     "SERVE_CALLS": "24",
     "SERVE_CLIENTS": "4",
+    # autotune smoke keeps the ADVERSARIAL geometry (block sizes just
+    # above a growth-2 rung — the pad-waste contract is about where the
+    # cluster sits, not row volume) and trims block count/cells/iters
+    "AUTOTUNE_BLOCKS": "12",
+    "AUTOTUNE_CELLS": "8",
+    "AUTOTUNE_ITERS": "2",
+    "AUTOTUNE_GROUP_ROWS": "2000",
+    "AUTOTUNE_STREAM_ITERS": "2",
+    "AUTOTUNE_DECODE_MS": "15",
 }
 
 
@@ -99,6 +108,7 @@ def main():
         "ingest_bench",
         "overload_bench",
         "serving_bench",
+        "autotune_bench",
         # LAST THREE: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them

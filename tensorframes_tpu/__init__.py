@@ -70,11 +70,23 @@ from .utils.telemetry import diagnostics
 # .load() / WorkloadProfile.save/merge/diff (runtime/profiler.py)
 from .runtime import profiler as profile
 
+# the closed-loop autotuner: tfs.autotune() is the one-shot pass
+# (recommend from a live or saved WorkloadProfile, apply through the
+# pin-respecting tuned-config layer); the background loop rides
+# config.autotune / TFS_AUTOTUNE below
+from .runtime.autotune import autotune
+from .runtime import autotune as _autotune_mod
+
 # Live telemetry endpoint auto-start: serve /metrics /healthz
 # /diagnostics /trace IFF the operator set TFS_TELEMETRY_PORT /
 # config.telemetry_port (off by default — `maybe_serve` is a no-op
 # then, and never raises).
 telemetry.maybe_serve()
+
+# Closed-loop autotuner auto-start: spin the background tuning loop
+# IFF config.autotune / TFS_AUTOTUNE is on (off by default — a strict
+# no-op then: no thread starts and no knob is ever mutated).
+_autotune_mod.maybe_start()
 
 __all__ = [
     "Column",
@@ -120,4 +132,5 @@ __all__ = [
     "telemetry",
     "diagnostics",
     "profile",
+    "autotune",
 ]

@@ -22,6 +22,22 @@ Knobs:
   a fresh TF session per task, `DebugRowOps.scala:790`).
 - ``aggregate_buffer_rows``: host-side group batching threshold (the
   reference's hardcoded ``bufferSize=10``, `DebugRowOps.scala:580`).
+
+Pin tracking (the autotuner's "never fight a pin" substrate): every
+knob set EXPLICITLY — through `update()`, inside an `override()` scope,
+or seeded from a well-formed ``TFS_*`` env var at import — is recorded
+as *pinned* (`explicit_keys()` / `is_explicit()`). The closed-loop
+autotuner (`runtime.autotune`) writes knobs only through `set_tuned()`,
+which refuses pinned keys, so an operator's explicit setting always
+wins over a tuned one; `tuned()` reports what the tuner currently owns
+and `reset_tuning()` restores those knobs to their (env-seeded)
+defaults. A later `update()` of a tuned knob converts it to a pin.
+
+Env parsing: every ``TFS_*`` scalar override reads through the
+malformed-env-falls-back-to-default helpers below — a typo'd value
+must never break the package import (the histogram_buckets JSON knob
+established the convention); a malformed value is ignored entirely
+(default value, no pin).
 """
 
 from __future__ import annotations
@@ -30,7 +46,77 @@ import contextlib
 import dataclasses
 from typing import Optional
 
-__all__ = ["Config", "get", "update", "override"]
+__all__ = [
+    "Config",
+    "get",
+    "update",
+    "override",
+    "explicit_keys",
+    "is_explicit",
+    "set_tuned",
+    "tuned",
+    "default_value",
+    "reset_tuning",
+]
+
+
+# fields whose env var was present AND parsed cleanly during Config
+# construction — the import-time pin seed (a malformed value falls back
+# to the default and pins nothing). Populated by the _env_* helpers;
+# re-running a default_factory (e.g. `Config()` inside default_value)
+# only re-adds the same names, so the set is stable.
+_ENV_SEEDED: set = set()
+
+
+def _env_bool(var: str, default: bool, field: str) -> bool:
+    import os
+
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    _ENV_SEEDED.add(field)
+    return raw.lower() not in ("0", "false", "off")
+
+
+def _env_int(var: str, default: int, field: str,
+             minimum: Optional[int] = None) -> int:
+    import os
+
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = int(raw)
+    except (TypeError, ValueError):
+        return default  # malformed env never breaks the import
+    _ENV_SEEDED.add(field)
+    return v if minimum is None else max(minimum, v)
+
+
+def _env_float(var: str, default: float, field: str) -> float:
+    import os
+
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        return default  # malformed env never breaks the import
+    _ENV_SEEDED.add(field)
+    return v
+
+
+def _env_str(var: str, default: str, field: str,
+             mapping: Optional[dict] = None) -> str:
+    import os
+
+    raw = os.environ.get(var)
+    if raw is None or raw == "":
+        return default
+    _ENV_SEEDED.add(field)
+    low = raw.lower()
+    return mapping.get(low, low) if mapping is not None else raw
 
 
 def _env_histogram_buckets():
@@ -46,7 +132,10 @@ def _env_histogram_buckets():
         return None
     try:
         val = json.loads(raw)
-        return val if isinstance(val, dict) else None
+        if isinstance(val, dict):
+            _ENV_SEEDED.add("histogram_buckets")
+            return val
+        return None
     except Exception:
         return None
 
@@ -98,9 +187,9 @@ class Config:
     # compile counts. Env override TFS_SHAPE_BUCKETING ("0" disables)
     # seeds the initial value, mirroring TFS_NATIVE_EXECUTOR.
     shape_bucketing: bool = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
-            "TFS_SHAPE_BUCKETING", "1"
-        ).lower() not in ("0", "false", "off")
+        default_factory=lambda: _env_bool(
+            "TFS_SHAPE_BUCKETING", True, "shape_bucketing"
+        )
     )
     # Bucket-ladder geometry: rung k holds min * growth^k rows. Growth
     # trades pad waste (worst-case (growth-1)/growth of a block) against
@@ -128,15 +217,11 @@ class Config:
     # float sum/mean. Env override TFS_BLOCK_SCHEDULER seeds the initial
     # value, mirroring TFS_SHAPE_BUCKETING.
     block_scheduler: str = dataclasses.field(
-        default_factory=lambda: {
-            "0": "off", "false": "off", "1": "on", "true": "on",
-        }.get(
-            __import__("os").environ.get(
-                "TFS_BLOCK_SCHEDULER", "auto"
-            ).lower(),
-            __import__("os").environ.get(
-                "TFS_BLOCK_SCHEDULER", "auto"
-            ).lower(),
+        default_factory=lambda: _env_str(
+            "TFS_BLOCK_SCHEDULER", "auto", "block_scheduler",
+            mapping={
+                "0": "off", "false": "off", "1": "on", "true": "on",
+            },
         )
     )
     # Pipelined ingest (`ingest.pipeline`): stream verbs and the io
@@ -149,9 +234,9 @@ class Config:
     # Env override TFS_INGEST_PIPELINE ("0" disables) seeds the initial
     # value, mirroring TFS_SHAPE_BUCKETING.
     ingest_pipeline: bool = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
-            "TFS_INGEST_PIPELINE", "1"
-        ).lower() not in ("0", "false", "off")
+        default_factory=lambda: _env_bool(
+            "TFS_INGEST_PIPELINE", True, "ingest_pipeline"
+        )
     )
     # Delivery-queue bound of the ingest pipeline (was the hard-coded
     # depth=1 of `_prefetch_iter`): how many decoded chunks may sit
@@ -163,10 +248,10 @@ class Config:
     # chunk decode time is bursty; lower it when chunks are huge. Env
     # override TFS_STREAM_PREFETCH_DEPTH seeds the initial value.
     stream_prefetch_depth: int = dataclasses.field(
-        default_factory=lambda: max(1, int(
-            __import__("os").environ.get("TFS_STREAM_PREFETCH_DEPTH", "1")
-            or "1"
-        ))
+        default_factory=lambda: _env_int(
+            "TFS_STREAM_PREFETCH_DEPTH", 1, "stream_prefetch_depth",
+            minimum=1,
+        )
     )
     # Decode thread-pool width for multi-file datasets
     # (`ingest.dataset.IngestStream`): 0 = auto (min(4, host cores)).
@@ -175,9 +260,8 @@ class Config:
     # the shared reorder window. Env override TFS_INGEST_DECODE_WORKERS
     # seeds the initial value.
     ingest_decode_workers: int = dataclasses.field(
-        default_factory=lambda: int(
-            __import__("os").environ.get("TFS_INGEST_DECODE_WORKERS", "0")
-            or "0"
+        default_factory=lambda: _env_int(
+            "TFS_INGEST_DECODE_WORKERS", 0, "ingest_decode_workers"
         )
     )
     # One-time per-program warning when jit has compiled more than this
@@ -193,9 +277,7 @@ class Config:
     # way. Env override TFS_TELEMETRY ("0" disables) seeds the initial
     # value, mirroring TFS_SHAPE_BUCKETING.
     telemetry: bool = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
-            "TFS_TELEMETRY", "1"
-        ).lower() not in ("0", "false", "off")
+        default_factory=lambda: _env_bool("TFS_TELEMETRY", True, "telemetry")
     )
     # Span ring-buffer bound (`utils.telemetry`): a long-lived service
     # keeps the freshest N spans and counts what fell off — memory stays
@@ -214,8 +296,8 @@ class Config:
     # TFS_TELEMETRY_PORT seeds the initial value (set it and the
     # package import starts the server).
     telemetry_port: int = dataclasses.field(
-        default_factory=lambda: int(
-            __import__("os").environ.get("TFS_TELEMETRY_PORT", "0") or "0"
+        default_factory=lambda: _env_int(
+            "TFS_TELEMETRY_PORT", 0, "telemetry_port"
         )
     )
     telemetry_host: str = "127.0.0.1"
@@ -257,9 +339,9 @@ class Config:
     # even when span recording is off). Env override TFS_COST_LEDGER
     # ("0" disables) seeds the initial value.
     cost_ledger: bool = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
-            "TFS_COST_LEDGER", "1"
-        ).lower() not in ("0", "false", "off")
+        default_factory=lambda: _env_bool(
+            "TFS_COST_LEDGER", True, "cost_ledger"
+        )
     )
     # Deep memory capture: additionally compile the lowered module at
     # capture time to read `memory_analysis()` (temp/scratch bytes —
@@ -319,9 +401,8 @@ class Config:
     # (classified deterministic — never burned as a retry). Env
     # override TFS_DEFAULT_VERB_TIMEOUT_S seeds the initial value.
     default_verb_timeout_s: float = dataclasses.field(
-        default_factory=lambda: float(
-            __import__("os").environ.get("TFS_DEFAULT_VERB_TIMEOUT_S", "0")
-            or "0"
+        default_factory=lambda: _env_float(
+            "TFS_DEFAULT_VERB_TIMEOUT_S", 0.0, "default_verb_timeout_s"
         )
     )
     # Admission control (`runtime.deadline.AdmissionController`): max
@@ -331,17 +412,23 @@ class Config:
     # TFS_MAX_CONCURRENT_VERBS seeds the initial value — the serving
     # lane's knob.
     max_concurrent_verbs: int = dataclasses.field(
-        default_factory=lambda: int(
-            __import__("os").environ.get("TFS_MAX_CONCURRENT_VERBS", "0")
-            or "0"
+        default_factory=lambda: _env_int(
+            "TFS_MAX_CONCURRENT_VERBS", 0, "max_concurrent_verbs"
         )
     )
     # Bounded admission wait queue: callers beyond the concurrency
     # limit queue up to this many deep; arrivals at a full queue are
     # SHED immediately with a typed OverloadError (queue depth +
     # retry-after hint from the live verb_seconds histogram). 0 = shed
-    # the moment the limit is reached (no queueing).
-    admission_queue_limit: int = 32
+    # the moment the limit is reached (no queueing). Env override
+    # TFS_ADMISSION_QUEUE_LIMIT seeds the initial value — the sibling
+    # of TFS_MAX_CONCURRENT_VERBS, so both admission knobs deploy
+    # without code changes.
+    admission_queue_limit: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_ADMISSION_QUEUE_LIMIT", 32, "admission_queue_limit"
+        )
+    )
     # Max seconds a queued caller waits for a slot before being shed
     # (its own deadline still applies and may fire first). 0 = wait
     # bounded only by the caller's deadline — do not combine 0 with
@@ -361,9 +448,8 @@ class Config:
     # baseline serving_bench measures against). Env override
     # TFS_SERVE_BATCH_WINDOW_MS seeds the initial value.
     serve_batch_window_ms: float = dataclasses.field(
-        default_factory=lambda: float(
-            __import__("os").environ.get("TFS_SERVE_BATCH_WINDOW_MS", "5")
-            or "5"
+        default_factory=lambda: _env_float(
+            "TFS_SERVE_BATCH_WINDOW_MS", 5.0, "serve_batch_window_ms"
         )
     )
     # serve_max_batch_rows: ceiling on one coalesced dispatch AND the
@@ -374,9 +460,8 @@ class Config:
     # (alone), paying its own compile. Env override
     # TFS_SERVE_MAX_BATCH_ROWS seeds the initial value.
     serve_max_batch_rows: int = dataclasses.field(
-        default_factory=lambda: int(
-            __import__("os").environ.get("TFS_SERVE_MAX_BATCH_ROWS", "4096")
-            or "4096"
+        default_factory=lambda: _env_int(
+            "TFS_SERVE_MAX_BATCH_ROWS", 4096, "serve_max_batch_rows"
         )
     )
     # serve_queue_limit: max requests queued per (endpoint x program)
@@ -384,13 +469,24 @@ class Config:
     # typed OverloadError (HTTP 429 + Retry-After at the server) so a
     # slow endpoint builds bounded queues, never unbounded latency.
     # 0 = unlimited (bounded only by admission control + deadlines).
-    serve_queue_limit: int = 256
+    # Env override TFS_SERVE_QUEUE_LIMIT seeds the initial value so a
+    # tuned deployment needs no code change.
+    serve_queue_limit: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_SERVE_QUEUE_LIMIT", 256, "serve_queue_limit"
+        )
+    )
     # serve_default_timeout_s: per-request deadline the server applies
     # when the client sends no X-TFS-Timeout-S header. Unlike
     # default_verb_timeout_s (a library-wide opt-in), a serving request
     # ALWAYS has a budget — an un-deadlined request behind a wedged
-    # endpoint would strand its server thread forever.
-    serve_default_timeout_s: float = 30.0
+    # endpoint would strand its server thread forever. Env override
+    # TFS_SERVE_DEFAULT_TIMEOUT_S seeds the initial value.
+    serve_default_timeout_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_SERVE_DEFAULT_TIMEOUT_S", 30.0, "serve_default_timeout_s"
+        )
+    )
     # serve_warm_compile: compile every bucket-ladder rung up to
     # serve_max_batch_rows at `serving.register()` time (row-local
     # endpoints only — others cannot pad, so rung warming cannot cover
@@ -403,9 +499,30 @@ class Config:
     # (the stuck-shared-TPU failure mode). 0 disables the watchdog.
     # Env override TFS_DEVICE_GRANT_TIMEOUT_S seeds the initial value.
     device_grant_timeout_s: float = dataclasses.field(
-        default_factory=lambda: float(
-            __import__("os").environ.get("TFS_DEVICE_GRANT_TIMEOUT_S", "0")
-            or "0"
+        default_factory=lambda: _env_float(
+            "TFS_DEVICE_GRANT_TIMEOUT_S", 0.0, "device_grant_timeout_s"
+        )
+    )
+    # Closed-loop autotuner (`runtime.autotune`): when on, a background
+    # daemon thread periodically snapshots the live workload profile
+    # and nudges the UNPINNED performance knobs (bucket-ladder
+    # growth/min, ingest decode workers / prefetch depth, per-endpoint
+    # serving batch window, max_concurrent_verbs) toward what the
+    # telemetry says the workload wants — hysteresis dead-bands + step
+    # and safety bounds keep it from oscillating, and a knob set
+    # explicitly (update()/override()/TFS_* env) is NEVER touched. Off
+    # (the default) = zero behavior change: no thread starts and no
+    # knob is ever mutated; `tfs.autotune()` stays available for
+    # one-shot offline tuning either way. Env override TFS_AUTOTUNE
+    # seeds the initial value.
+    autotune: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("TFS_AUTOTUNE", False, "autotune")
+    )
+    # Seconds between background tuning cycles (each cycle: snapshot ->
+    # recommend -> apply). Env override TFS_AUTOTUNE_INTERVAL_S.
+    autotune_interval_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_AUTOTUNE_INTERVAL_S", 30.0, "autotune_interval_s"
         )
     )
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).
@@ -428,8 +545,8 @@ class Config:
     # Env override TFS_NATIVE_EXECUTOR seeds the initial value so a CI
     # lane can run the whole verb suite under the native default.
     native_executor: str = dataclasses.field(
-        default_factory=lambda: __import__("os").environ.get(
-            "TFS_NATIVE_EXECUTOR", "off"
+        default_factory=lambda: _env_str(
+            "TFS_NATIVE_EXECUTOR", "off", "native_executor"
         )
     )
 
@@ -445,6 +562,82 @@ class Config:
 
 _config = Config()
 
+# ---------------------------------------------------------------------------
+# pin / tuned-value bookkeeping (see the module docstring)
+# ---------------------------------------------------------------------------
+
+# one lock serializes every pin/tuned mutation (update / set_tuned /
+# reset_tuning): the autotuner runs on a background thread, and the
+# "pins win, always" contract needs check-then-write to be atomic —
+# an operator update() racing a set_tuned() must never lose
+import threading as _threading
+
+_state_lock = _threading.Lock()
+
+# knobs the OPERATOR set: update()/override() calls plus well-formed
+# TFS_* env seeds captured while _config was constructed above. The
+# autotuner must never write these.
+_EXPLICIT: set = set(_ENV_SEEDED)
+# knobs the AUTOTUNER currently owns -> the value it applied. Distinct
+# from _EXPLICIT so diagnostics can say which values are tuned, and so
+# reset_tuning() knows what to restore.
+_TUNED: dict = {}
+
+_MISSING = object()
+
+
+def explicit_keys() -> frozenset:
+    """Knobs pinned by the operator (update()/override()/env) — the
+    set the autotuner's "never fight a pin" rule checks against."""
+    return frozenset(_EXPLICIT)
+
+
+def is_explicit(key: str) -> bool:
+    return key in _EXPLICIT
+
+
+def tuned() -> dict:
+    """``{knob: value}`` currently owned by the autotuner."""
+    return dict(_TUNED)
+
+
+def default_value(key: str):
+    """The knob's baseline: the dataclass default, env-seeded the same
+    way the process's initial config was — what `reset_tuning` restores
+    and what policies treat as "the static default"."""
+    base = Config()
+    if not hasattr(base, key):
+        raise AttributeError(f"unknown config key {key!r}")
+    return getattr(base, key)
+
+
+def set_tuned(key: str, value) -> bool:
+    """The autotuner's ONLY write path: apply ``value`` unless the knob
+    is explicitly pinned. Returns False (and changes nothing) for a
+    pinned knob — an operator's explicit setting always wins. The
+    pin check and the write are one atomic step under the state lock,
+    so a concurrent `update()` can never be overwritten."""
+    if not hasattr(_config, key):
+        raise AttributeError(f"unknown config key {key!r}")
+    with _state_lock:
+        if key in _EXPLICIT:
+            return False
+        setattr(_config, key, value)
+        _TUNED[key] = value
+    return True
+
+
+def reset_tuning() -> None:
+    """Restore every tuned knob to its (env-seeded) default and forget
+    the tuned set — the test-isolation hook, and the operator's undo."""
+    if not _TUNED:
+        return
+    base = Config()
+    with _state_lock:
+        for k in list(_TUNED):
+            setattr(_config, k, getattr(base, k))
+        _TUNED.clear()
+
 
 def get() -> Config:
     return _config
@@ -454,7 +647,13 @@ def update(**kwargs) -> None:
     for k, v in kwargs.items():
         if not hasattr(_config, k):
             raise AttributeError(f"unknown config key {k!r}")
-        setattr(_config, k, v)
+        with _state_lock:
+            setattr(_config, k, v)
+            # an explicit set PINS the knob: the autotuner may no
+            # longer touch it, and any tuned value it carried is
+            # superseded
+            _EXPLICIT.add(k)
+            _TUNED.pop(k, None)
     if "compilation_cache_dir" in kwargs and kwargs["compilation_cache_dir"]:
         import jax
 
@@ -466,8 +665,22 @@ def update(**kwargs) -> None:
 @contextlib.contextmanager
 def override(**kwargs):
     old = {k: getattr(_config, k) for k in kwargs}
+    # pin state is scoped like the values: a knob pinned only inside an
+    # override() is un-pinned again on exit (and a tuned value it
+    # shadowed is restored to the tuned ledger)
+    old_explicit = {k: (k in _EXPLICIT) for k in kwargs}
+    old_tuned = {k: _TUNED.get(k, _MISSING) for k in kwargs}
     update(**kwargs)
     try:
         yield _config
     finally:
         update(**old)
+        # the pin/ledger restore shares the state lock with
+        # set_tuned(): a background tuner write interleaving here
+        # would otherwise desync _TUNED from the value in force
+        with _state_lock:
+            for k in kwargs:
+                if not old_explicit[k]:
+                    _EXPLICIT.discard(k)
+                if old_tuned[k] is not _MISSING:
+                    _TUNED[k] = old_tuned[k]

@@ -34,8 +34,12 @@ absent):
 - **admission** — admitted/shed totals, peak in-flight, queued-wait
   seconds, per-verb deadline expiries;
 - **residuals** — the cost-model accuracy join
-  (`costmodel.residuals`): per-program achieved-vs-predicted ratios
-  and the fitted effective throughput.
+  (`costmodel.residuals`): per-program achieved-vs-predicted ratios,
+  the fitted effective throughput, and the roofline saturation rollup
+  (``peak_ratio_max``) the admission autotuner reads;
+- **autotune** — the closed-loop tuner's state (`runtime.autotune
+  .state()`): currently tuned knobs, the pin set, per-endpoint batch
+  windows, recent decisions.
 
 Operations: ``save(path)`` / ``load(path)`` (versioned JSON),
 ``merge(other)`` (counter sums, exact histogram merges — mismatched
@@ -605,13 +609,28 @@ def snapshot(note: Optional[str] = None) -> WorkloadProfile:
         from . import costmodel as _cm
 
         res = _cm.residuals()
+        ratios = [
+            g["peak_ratio"] for g in res.get("groups", [])
+            if g.get("peak_ratio") is not None
+        ]
         data["residuals"] = {
             "warn_ratio": res["warn_ratio"],
             "fit": res["fit"],
             "programs": res["programs"],
+            # roofline saturation rollup (the admission autotuner's
+            # signal): the highest achieved-vs-datasheet-peak ratio any
+            # (program x rung) group reached; honest None where no
+            # datasheet peak exists (CPU)
+            "peak_ratio_max": max(ratios) if ratios else None,
         }
     except Exception as e:
         data["residuals"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        from . import autotune as _at
+
+        data["autotune"] = _at.state()
+    except Exception as e:
+        data["autotune"] = {"error": f"{type(e).__name__}: {e}"}
     return WorkloadProfile(data)
 
 

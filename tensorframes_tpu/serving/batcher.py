@@ -60,6 +60,29 @@ from ..runtime import deadline as _dl
 __all__ = ["MicroBatcher", "batcher"]
 
 
+def _window_s(endpoint, cfg) -> float:
+    """Effective coalescing window for one endpoint, in seconds: the
+    endpoint's own ``batch_window_ms`` (the autotuner's per-endpoint
+    override) when set, else the global config knob. Read per batch,
+    so a tuned change applies to the next window immediately — and an
+    operator PIN of the global knob (explicit update/override/env)
+    wins over any previously tuned endpoint value at read time, so
+    pinning after the tuner ran still takes effect everywhere."""
+    w = getattr(endpoint, "batch_window_ms", None)
+    if w is None or _window_pinned():
+        w = float(getattr(cfg, "serve_batch_window_ms", 0.0))
+    return float(w) / 1e3
+
+
+def _window_pinned() -> bool:
+    try:
+        from .. import config as _config
+
+        return _config.is_explicit("serve_batch_window_ms")
+    except Exception:
+        return False
+
+
 class _Request:
     __slots__ = (
         "frame", "rows", "future", "request_id", "deadline_at", "t_enq",
@@ -148,7 +171,7 @@ class MicroBatcher:
         from .. import config as _config
 
         cfg = _config.get()
-        window_s = float(getattr(cfg, "serve_batch_window_ms", 0.0)) / 1e3
+        window_s = _window_s(endpoint, cfg)
         fut: Future = Future()
 
         if not endpoint.batchable or window_s <= 0.0:
@@ -262,9 +285,7 @@ class MicroBatcher:
                 if not lane.queue and lane.stop:
                     return
                 cfg = _config.get()
-                window_s = float(
-                    getattr(cfg, "serve_batch_window_ms", 0.0)
-                ) / 1e3
+                window_s = _window_s(ep, cfg)
                 max_rows = ep.max_batch_rows
                 t_close = time.monotonic() + window_s
                 batch: List[_Request] = []

@@ -155,6 +155,13 @@ class Endpoint:
         self.fingerprint = graph.fingerprint()
         self.warmed_rungs: Tuple[int, ...] = ()
         self.created_at = time.time()
+        # per-endpoint batch-window override (milliseconds): None =
+        # follow config.serve_batch_window_ms. Written by the
+        # closed-loop autotuner (`runtime.autotune` — the batch-window
+        # policy tunes each endpoint separately from the latency-vs-
+        # fill histograms); the batcher reads it per batch, so a change
+        # applies to the next window without restarting the lane.
+        self.batch_window_ms: Optional[float] = None
 
     # -- request validation --------------------------------------------
     def validate_request(self, frame: TensorFrame) -> None:
@@ -258,6 +265,7 @@ class Endpoint:
             "program": self.fingerprint,
             "batchable": self.batchable,
             "max_batch_rows": self.max_batch_rows,
+            "batch_window_ms": self.batch_window_ms,
             "warmed_rungs": list(self.warmed_rungs),
             "columns": {
                 ci.name: {

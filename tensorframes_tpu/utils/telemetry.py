@@ -934,6 +934,7 @@ _PROM_HELP: Dict[str, str] = {
     "device_grant_timeouts": "Device acquisitions abandoned by watchdog",
     "deadline_exceeded": "Verb deadline expiries by verb",
     "verbs_shed": "Verbs rejected by admission control",
+    "autotune_adjustments": "Knob adjustments applied by the autotuner",
     "admission_wait_seconds": "Time spent queued for a verb slot",
     "admission_queue_depth": "Verbs queued for admission right now",
     "admission_in_flight": "Admitted top-level verbs in flight",
@@ -1160,6 +1161,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["forensics"] = _faults.forensics_snapshot()
     except Exception as e:
         data["faults_error"] = f"{type(e).__name__}: {e}"
+
+    # closed-loop autotuner: tuned knobs, pins, recent decisions --------
+    try:
+        from ..runtime import autotune as _autotune
+
+        data["autotune"] = _autotune.state()
+    except Exception as e:
+        data["autotune"] = {"error": f"{type(e).__name__}: {e}"}
 
     # executor + recompile-storm signal ---------------------------------
     try:
@@ -1424,6 +1433,41 @@ def _render_diagnostics(data: Dict) -> str:
                 f"in_use={_fmt_bytes(m['bytes_in_use'])} "
                 f"peak={_fmt_bytes(m['peak_bytes_in_use'])}"
             )
+
+    # closed-loop autotuner ---------------------------------------------
+    at = data.get("autotune", {})
+    if at and "error" not in at:
+        tuned = at.get("tuned", {})
+        ep_windows = at.get("endpoint_windows", {})
+        if at.get("enabled") or tuned or ep_windows:
+            lines.append("")
+            lines.append(
+                "autotune: "
+                + ("loop ON" if at.get("enabled") else "loop off")
+                + (
+                    f" (running, {at.get('cycles', 0)} cycle(s), every "
+                    f"{at.get('interval_s', 0):g}s)"
+                    if at.get("running")
+                    else ""
+                )
+            )
+            for knob, v in sorted(tuned.items()):
+                lines.append(f"  tuned {knob} = {v}")
+            for ep, w in sorted(ep_windows.items()):
+                lines.append(
+                    f"  tuned serve_batch_window_ms[{ep}] = {w:g}"
+                )
+            if at.get("pinned"):
+                lines.append(
+                    "  pinned (never tuned): "
+                    + ", ".join(at["pinned"])
+                )
+            for dec in at.get("decisions", [])[-4:]:
+                lines.append(
+                    f"  decision: {dec.get('knob')} ({dec.get('scope')}) "
+                    f"{dec.get('current')} -> {dec.get('proposed')} "
+                    f"[{dec.get('outcome')}]"
+                )
 
     # executor + recompile-storm signal ---------------------------------
     if "executor_error" in data:

@@ -38,11 +38,13 @@ def _reset_telemetry():
     (circuit breakers are process-global) and ledger counts must never
     bleed into the next test's scheduling."""
     yield
-    from tensorframes_tpu import serving
-    from tensorframes_tpu.runtime import costmodel, deadline, faults
+    from tensorframes_tpu import config, serving
+    from tensorframes_tpu.runtime import autotune, costmodel, deadline, faults
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
+    autotune.reset()  # a test's tuning loop/decisions never outlive it
+    config.reset_tuning()  # tuned knobs revert to their defaults
     serving.reset()  # before telemetry: lanes may still emit counters
     telemetry.reset()
     faults.reset_ledger()

@@ -1,0 +1,211 @@
+"""Durable-stream checkpoint bench: commit overhead + resume skipping.
+
+The ISSUE-13 contract: periodically committing a streaming reduce's
+progress (atomic manifest + partial table every CKPT_EVERY folded
+chunks, `runtime.checkpoint`) must cost <= 5% of the stream's wall
+time — durability is a background tax, not a second pass — and a
+resumed stream must SKIP at least the committed watermark's chunks at
+the task-metadata level (asserted via the ingest decode-stage counter:
+a resume over a completed checkpoint decodes ZERO chunks).
+
+Legs:
+1. A/B the same multi-shard Parquet stream reduce with checkpointing
+   off vs on (best-of CKPT_ITERS): overhead <= 5%, or <= an absolute
+   floor at smoke sizes where a single fsync dwarfs the tiny stream
+   (reason line emitted when the floor carries the verdict). min/max
+   bit-identical, sum within the documented tolerance.
+2. Re-issue the checkpointed call: the resume validates the manifest,
+   restores the partials, decodes nothing, and returns the identical
+   result.
+
+Sizes: CKPT_SHARDS (8) x CKPT_GROUPS (4 row groups) x CKPT_GROUP_ROWS
+(200_000) float32 rows, commits every CKPT_EVERY (4) folded chunks.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _util import emit, scaled  # noqa: E402
+
+# smoke streams finish in tens of ms, where a handful of fsyncs is a
+# double-digit percentage all by itself; the absolute floor keeps the
+# verdict about COMMIT COST, not filesystem latency vs a tiny stream
+ABS_FLOOR_S = 0.06
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import dsl
+    from tensorframes_tpu import io as tio
+    from tensorframes_tpu.runtime import checkpoint as ckpt_mod
+    from tensorframes_tpu.utils import telemetry
+
+    shards = scaled("CKPT_SHARDS", 8)
+    groups = scaled("CKPT_GROUPS", 4)
+    group_rows = scaled("CKPT_GROUP_ROWS", 200_000)
+    iters = scaled("CKPT_ITERS", 3)
+    every = scaled("CKPT_EVERY", 4)
+    total_rows = shards * groups * group_rows
+    total_chunks = shards * groups
+
+    root = tempfile.mkdtemp(prefix="tfs_ckpt_bench_")
+    try:
+        rng = np.random.RandomState(0)
+        parts = []
+        for i in range(shards):
+            x = rng.rand(groups * group_rows).astype(np.float32)
+            parts.append(x)
+            tio.write_parquet(
+                tfs.TensorFrame.from_dict({"x": x}, num_blocks=groups),
+                os.path.join(root, f"shard-{i:04d}.parquet"),
+            )
+        allx = np.concatenate(parts)
+        del parts
+
+        df0 = tfs.TensorFrame.from_dict({"x": allx[:2]})
+        fetches = [
+            dsl.reduce_sum(
+                tfs.block(df0, "x", tf_name="s_input"), axes=[0]
+            ).named("s"),
+            dsl.reduce_min(
+                tfs.block(df0, "x", tf_name="mn_input"), axes=[0]
+            ).named("mn"),
+            dsl.reduce_max(
+                tfs.block(df0, "x", tf_name="mx_input"), axes=[0]
+            ).named("mx"),
+        ]
+        feeds = {"s_input": "x", "mn_input": "x", "mx_input": "x"}
+        ck = os.path.join(root, "stream.tfsckpt")
+
+        def run_stream(checkpointed: bool):
+            kw = (
+                {"checkpoint": ck, "checkpoint_every": every}
+                if checkpointed
+                else {}
+            )
+            return tfs.reduce_blocks_stream(
+                fetches, tfs.stream_dataset(root), feed_dict=feeds, **kw
+            )
+
+        def timed(checkpointed: bool):
+            best, out = float("inf"), None
+            for _ in range(iters):
+                if checkpointed and os.path.exists(ck):
+                    os.unlink(ck)  # each pass measures a FRESH run
+                t0 = time.perf_counter()
+                out = run_stream(checkpointed)
+                _ = [np.asarray(v) for v in out.values()]  # settle
+                best = min(best, time.perf_counter() - t0)
+            return best, out
+
+        _ = run_stream(False)  # warm the chunk + combine programs
+
+        dt_off, out_off = timed(False)
+        ckpt_mod.reset_state()
+        dt_on, out_on = timed(True)
+        commits = ckpt_mod.state()["commits"] // iters
+
+        overhead_s = dt_on - dt_off
+        overhead_pct = 100.0 * overhead_s / max(dt_off, 1e-9)
+        emit(
+            f"checkpoint off: {shards} shards x {groups} groups "
+            f"({total_rows} rows)",
+            round(total_rows / dt_off),
+            "rows/s",
+        )
+        emit(
+            f"checkpoint on (every {every} chunks, {commits} commits)",
+            round(total_rows / dt_on),
+            "rows/s",
+        )
+        emit(
+            "checkpoint commit overhead", round(overhead_pct, 2), "%"
+        )
+
+        # -- correctness contracts (unconditional) ----------------------
+        whole = tfs.TensorFrame.from_dict({"x": allx}, num_blocks=shards)
+        ref = tfs.reduce_blocks(fetches, whole, feed_dict=feeds)
+        for got in (out_on, out_off):
+            assert float(got["mn"]) == float(ref["mn"]), "min not bit-identical"
+            assert float(got["mx"]) == float(ref["mx"]), "max not bit-identical"
+            np.testing.assert_allclose(
+                float(got["s"]), float(ref["s"]), rtol=1e-5
+            )
+        emit("checkpoint min/max bit-identical, sum rtol 1e-5", 1, "bool")
+
+        # -- the overhead contract --------------------------------------
+        if overhead_s <= ABS_FLOOR_S and overhead_pct > 5.0:
+            emit(
+                f"checkpoint overhead verdict by absolute floor "
+                f"({overhead_s * 1e3:.1f}ms <= {ABS_FLOOR_S * 1e3:.0f}ms; "
+                "smoke-size stream too small for a % verdict)",
+                1,
+                "bool",
+            )
+        else:
+            assert overhead_pct <= 5.0, (
+                f"checkpoint commit overhead {overhead_pct:.2f}% > 5% "
+                f"({overhead_s * 1e3:.1f}ms over {dt_off * 1e3:.1f}ms, "
+                f"{commits} commits)"
+            )
+
+        # -- resume skipping >= watermark chunks ------------------------
+        from tensorframes_tpu.runtime.checkpoint import CheckpointStore
+
+        manifest, _ = CheckpointStore(ck).load()
+        watermark = int(manifest["watermark"])
+        assert watermark == total_chunks, (
+            f"completed run committed watermark {watermark}, "
+            f"expected {total_chunks}"
+        )
+        telemetry.reset()
+        ckpt_mod.reset_state()
+        t0 = time.perf_counter()
+        out_res = run_stream(True)
+        _ = [np.asarray(v) for v in out_res.values()]
+        dt_res = time.perf_counter() - t0
+        decodes = sum(
+            v
+            for (name, labels), v in telemetry.labeled_counters().items()
+            if name == "ingest_chunks"
+            and dict(labels).get("stage") == "decode"
+        )
+        skipped = total_chunks - int(decodes)
+        emit(
+            f"checkpoint resume skipped chunks (of {total_chunks}; "
+            f"watermark {watermark})",
+            skipped,
+            "chunks",
+        )
+        emit(
+            "checkpoint resume wall time", round(dt_res * 1e3, 1), "ms"
+        )
+        assert skipped >= watermark, (
+            f"resume re-decoded {decodes} chunks; expected >= "
+            f"{watermark} of {total_chunks} skipped"
+        )
+        assert ckpt_mod.state()["resumes"] == 1
+        for k in ("mn", "mx"):
+            assert float(out_res[k]) == float(ref[k]), (
+                f"resumed {k} not bit-identical"
+            )
+        np.testing.assert_allclose(
+            float(out_res["s"]), float(ref["s"]), rtol=1e-5
+        )
+        emit("checkpoint resume bit-identical (min/max)", 1, "bool")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

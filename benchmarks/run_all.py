@@ -81,6 +81,11 @@ SMOKE_SIZES = {
     "AUTOTUNE_GROUP_ROWS": "2000",
     "AUTOTUNE_STREAM_ITERS": "2",
     "AUTOTUNE_DECODE_MS": "15",
+    "CKPT_SHARDS": "4",
+    "CKPT_GROUPS": "2",
+    "CKPT_GROUP_ROWS": "20000",
+    "CKPT_ITERS": "2",
+    "CKPT_EVERY": "2",
 }
 
 
@@ -106,6 +111,7 @@ def main():
         "ragged_map_rows_bench",
         "stream_overlap_bench",
         "ingest_bench",
+        "checkpoint_bench",
         "overload_bench",
         "serving_bench",
         "autotune_bench",

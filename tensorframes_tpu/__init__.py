@@ -57,6 +57,7 @@ from .runtime.deadline import (
     OverloadError,
     deadline_scope,
 )
+from .runtime.checkpoint import CheckpointError
 from . import config
 from . import io
 from . import ingest
@@ -126,6 +127,7 @@ __all__ = [
     "dsl",
     "Executor",
     "Cancelled",
+    "CheckpointError",
     "DeadlineExceeded",
     "OverloadError",
     "deadline_scope",

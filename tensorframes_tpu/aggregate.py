@@ -29,7 +29,7 @@ from .frame import Column, TensorFrame, factorize_keys
 from .graph.analysis import GraphSummary
 from .graph.ir import Graph, base_name as _base
 from .ops.lowering import build_callable
-from .runtime.retry import maybe_check_numerics
+from .runtime.faults import maybe_check_numerics
 
 
 def _group_plan(

@@ -47,7 +47,7 @@ from .ops.lowering import build_callable
 from .runtime import deadline as _dl
 from .runtime.deadline import deadline_entry as _deadline_entry
 from .runtime.executor import Executor, default_executor
-from .runtime.retry import maybe_check_numerics
+from .runtime.faults import maybe_check_numerics
 from .schema import Shape
 
 __all__ = [

@@ -24,6 +24,8 @@ Parquet/IPC decode, so the pool gives real core parallelism.
 from __future__ import annotations
 
 import glob as _glob
+import hashlib
+import json
 import os
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional, Sequence, Tuple, Union
@@ -210,6 +212,45 @@ class Dataset:
                     pass
             raise
 
+    def task_list(self) -> List[ChunkTask]:
+        """`tasks()` materialized — still METADATA-only (file footers,
+        never chunk data). The checkpoint layer uses the list twice:
+        once for the dataset fingerprint, once to skip committed
+        chunks on resume without re-decoding them."""
+        return list(self.tasks())
+
+    def fingerprint(self, tasks: Optional[List[ChunkTask]] = None) -> str:
+        """Deterministic digest of the dataset's METADATA identity:
+        shard paths + formats + on-disk sizes, the chunking policy,
+        and every task's (shard, groups, row-count) tuple. This is
+        what the durable-stream manifest records — a resumed stream
+        whose dataset gained/lost/resized a shard (or whose row
+        groups moved) refuses loudly instead of folding drifted
+        chunks onto committed partials. Same-size same-row-count
+        content rewrites are beyond a metadata fingerprint; keep
+        checkpoints next to immutable datasets."""
+        if tasks is None:
+            tasks = self.task_list()
+        shards = []
+        for path, fmt in self.shards:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1
+            shards.append([os.path.abspath(path), fmt, size])
+        blob = json.dumps(
+            {
+                "chunk_groups": self.chunk_groups,
+                "shards": shards,
+                "tasks": [
+                    [t.shard, t.format, list(t.groups), t.rows]
+                    for t in tasks
+                ],
+            },
+            sort_keys=True,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
     # -- decode stage --------------------------------------------------
     def decode(self, task: ChunkTask):
         """One chunk -> one `TensorFrame`; opens and CLOSES the shard
@@ -277,9 +318,12 @@ class IngestStream:
         self.depth = depth
         self._active = None  # the running pipeline generator, once started
 
-    def source_and_stages(self):
+    def source_and_stages(self, tasks=None, skip: int = 0):
         """(source iterator, [decode stage]) — the pipeline prefix a
-        consumer composes further stages onto."""
+        consumer composes further stages onto. ``tasks`` reuses an
+        already-materialized `task_list()`; ``skip`` drops the first N
+        tasks at the METADATA level (the durable-stream resume path:
+        committed chunks are never re-decoded)."""
         decode = PipeStage(
             "decode",
             self.dataset.decode,
@@ -287,7 +331,15 @@ class IngestStream:
             context=_chunk_context,
             cheap_input=True,  # tasks are descriptors, not chunks
         )
-        return self.dataset.tasks(), [decode]
+        if skip:
+            if tasks is None:
+                tasks = self.dataset.task_list()
+            source = iter(tasks[int(skip):])
+        elif tasks is not None:
+            source = iter(tasks)
+        else:
+            source = self.dataset.tasks()
+        return source, [decode]
 
     @property
     def started(self) -> bool:

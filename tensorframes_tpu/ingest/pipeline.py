@@ -269,7 +269,7 @@ class _PipelineRoot:
         )
 
 
-def _serial_pipeline(source, stages: Sequence[PipeStage]):
+def _serial_pipeline(source, stages: Sequence[PipeStage], ordinal_base: int = 0):
     """Every stage inline on the consumer thread — no overlap, but the
     same stage functions, fault classification and error stamping as
     the threaded graph (the honest pipeline-off baseline)."""
@@ -278,7 +278,7 @@ def _serial_pipeline(source, stages: Sequence[PipeStage]):
     it = iter(source)
     scopes = [_fault_scope(s.name) for s in stages]
     root = _PipelineRoot()
-    ordinal = 0
+    ordinal = ordinal_base
     try:
         while True:
             _dl.check("ingest.pipeline")
@@ -296,7 +296,7 @@ def _serial_pipeline(source, stages: Sequence[PipeStage]):
             yield item
     finally:
         _close_source(it)
-        root.close(ordinal)
+        root.close(ordinal - ordinal_base)
 
 
 # ---------------------------------------------------------------------------
@@ -386,10 +386,12 @@ class _Graph:
                     break
 
 
-def _start_producer(g: _Graph, source, q_out: "queue.Queue") -> None:
+def _start_producer(
+    g: _Graph, source, q_out: "queue.Queue", ordinal_base: int = 0
+) -> None:
     def producer():
         it = None
-        idx = 0
+        idx = ordinal_base
         try:
             try:
                 # iter() INSIDE the try: a source whose __iter__ raises
@@ -458,10 +460,10 @@ class _PoolState:
     bounds how far workers may run ahead of delivery (the reorder
     buffer's chunk-memory cap)."""
 
-    def __init__(self, window: int):
+    def __init__(self, window: int, base: int = 0):
         self.cond = threading.Condition()
         self.buffer: Dict[int, tuple] = {}
-        self.next_emit = 0
+        self.next_emit = base
         self.end_at: Optional[int] = None
         self.done = False
         self.window = max(1, int(window))
@@ -474,10 +476,11 @@ def _start_pooled_stage(
     q_out: "queue.Queue",
     depth: int,
     parent: Optional[int] = None,
+    ordinal_base: int = 0,
 ) -> None:
     """A ``workers > 1`` stage: out-of-order execution, in-order
     delivery through a bounded reorder buffer."""
-    st = _PoolState(window=stage.workers + depth)
+    st = _PoolState(window=stage.workers + depth, base=ordinal_base)
     scope = _fault_scope(stage.name)
 
     def worker():
@@ -576,7 +579,12 @@ def _start_pooled_stage(
     g.spawn(emitter, f"tfs-ingest-{stage.name}-emit")
 
 
-def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = None):
+def pipelined(
+    source,
+    stages: Sequence[PipeStage] = (),
+    depth: Optional[int] = None,
+    ordinal_base: int = 0,
+):
     """Run ``source`` through ``stages`` as a concurrently-executing
     stage graph and yield the results in order.
 
@@ -584,11 +592,15 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
     ``config.stream_prefetch_depth``); the full chunk-memory bound is
     documented in the module docstring. With ``config.ingest_pipeline``
     off, runs the same stages inline on the consumer thread
-    (stage-serial). The generator owns the graph: closing/abandoning it
-    cancels every stage thread and drains the bounded queues; an error
-    in any stage surfaces here with ``tfs_chunk_index`` /
-    ``tfs_pipeline_stage`` (+ stage context) stamped, after which the
-    graph shuts down the same way."""
+    (stage-serial). ``ordinal_base`` offsets every chunk ordinal (span
+    labels, ``tfs_chunk_index`` stamps): a RESUMED durable stream
+    re-enters the pipeline at its committed watermark, and a failure at
+    post-resume chunk 3 must name the GLOBAL ordinal, not the third
+    chunk since restart. The generator owns the graph:
+    closing/abandoning it cancels every stage thread and drains the
+    bounded queues; an error in any stage surfaces here with
+    ``tfs_chunk_index`` / ``tfs_pipeline_stage`` (+ stage context)
+    stamped, after which the graph shuts down the same way."""
     from .. import config as _config
     from ..runtime import deadline as _dl
     from ..utils import telemetry as _tele
@@ -597,9 +609,10 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
     if depth is None:
         depth = getattr(cfg, "stream_prefetch_depth", 1)
     depth = max(1, int(depth))
+    ordinal_base = max(0, int(ordinal_base))
     stages = list(stages)
     if not getattr(cfg, "ingest_pipeline", True):
-        yield from _serial_pipeline(source, stages)
+        yield from _serial_pipeline(source, stages, ordinal_base)
         return
 
     # the consumer's deadline/cancel scope (this generator body first
@@ -627,14 +640,16 @@ def pipelined(source, stages: Sequence[PipeStage] = (), depth: Optional[int] = N
         q = g.make_queue(c0)
     else:
         q = g.make_queue(depth)
-    _start_producer(g, source, q)
+    _start_producer(g, source, q, ordinal_base)
     for i, stage in enumerate(stages):
         last = i == len(stages) - 1
         q_out = g.make_queue(depth if last else 1)
         if stage.workers == 1:
             _start_serial_stage(g, stage, q, q_out, parent)
         else:
-            _start_pooled_stage(g, stage, q, q_out, depth, parent)
+            _start_pooled_stage(
+                g, stage, q, q_out, depth, parent, ordinal_base
+            )
         q = q_out
 
     delivered = 0

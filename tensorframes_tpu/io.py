@@ -163,8 +163,18 @@ def frame_to_ipc_bytes(frame: TensorFrame) -> bytes:
 def frame_from_ipc_bytes(data: bytes) -> TensorFrame:
     """Rebuild a frame from `frame_to_ipc_bytes` output (record batches
     become blocks when they account for every row, exactly like the file
-    reader)."""
+    reader). Shared by the serving wire path AND the durable-stream
+    checkpoint payload (`runtime.checkpoint`) — one framing, two
+    consumers. Empty input is refused explicitly (a truncated body /
+    payload would otherwise surface as a cryptic Arrow internal
+    error)."""
     import pyarrow as pa
+
+    if not data:
+        raise ValueError(
+            "frame_from_ipc_bytes: empty byte string (expected an Arrow "
+            "IPC stream)"
+        )
 
     with pa.ipc.open_stream(pa.BufferReader(data)) as reader:
         batches = [b for b in reader]

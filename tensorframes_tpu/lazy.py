@@ -400,7 +400,7 @@ class LazyFrame:
             )
         from .graph.analysis import analyze_graph
         from .runtime.executor import default_executor
-        from .runtime.retry import maybe_check_numerics
+        from .runtime.faults import maybe_check_numerics
         from .utils.profiling import record
 
         ex = executor or default_executor()
@@ -608,7 +608,7 @@ class LazyFrame:
         ):
             return self._forced
         from .runtime.executor import default_executor
-        from .runtime.retry import maybe_check_numerics
+        from .runtime.faults import maybe_check_numerics
         from .utils.profiling import record
 
         ex = executor or self._executor or default_executor()

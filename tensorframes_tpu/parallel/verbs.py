@@ -44,7 +44,7 @@ from ..graph.ir import Graph, base_name, parse_edge
 from ..ops.lowering import build_callable
 from .. import api as _api
 from ..runtime.executor import Executor, default_executor, lru_get_or_insert
-from ..runtime.retry import maybe_check_numerics
+from ..runtime.faults import maybe_check_numerics
 
 __all__ = [
     "map_blocks",

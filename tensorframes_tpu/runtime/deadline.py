@@ -92,7 +92,12 @@ class DeadlineExceeded(TimeoutError):
     expired budget fails identically, so the fault layer surfaces it
     after exactly one attempt — a deadline is never burned as a retry.
     May carry ``tfs_blocks_issued`` / ``tfs_blocks_unissued`` partial-
-    work accounting stamped at the dispatch boundary that tripped."""
+    work accounting stamped at the dispatch boundary that tripped. A
+    CHECKPOINTED streaming reduce additionally stamps
+    ``tfs_checkpoint_path`` / ``tfs_checkpoint_watermark`` — the
+    durable progress the expired budget bought (`runtime.checkpoint`):
+    re-issuing the same call resumes from that watermark instead of
+    chunk zero."""
 
     tfs_fault_class = "deterministic"
 
@@ -103,17 +108,24 @@ class DeadlineExceeded(TimeoutError):
         self.verb = verb
         self.budget_s = budget_s
         self.elapsed_s = elapsed_s
+        self.tfs_checkpoint_path = None
+        self.tfs_checkpoint_watermark = None
 
 
 class Cancelled(RuntimeError):
     """The scope's cancel token fired (explicit `CancelScope.cancel`).
-    Deterministic for the classifier, like `DeadlineExceeded`."""
+    Deterministic for the classifier, like `DeadlineExceeded` — and
+    like it, a checkpointed stream stamps ``tfs_checkpoint_path`` /
+    ``tfs_checkpoint_watermark`` with the progress committed on the
+    way out."""
 
     tfs_fault_class = "deterministic"
 
     def __init__(self, message: str, reason: Optional[str] = None):
         super().__init__(message)
         self.reason = reason
+        self.tfs_checkpoint_path = None
+        self.tfs_checkpoint_watermark = None
 
 
 class OverloadError(RuntimeError):

@@ -75,6 +75,7 @@ __all__ = [
     "ledger_snapshot",
     "reset_ledger",
     "device_grant",
+    "maybe_check_numerics",
 ]
 
 _log = get_logger("faults")
@@ -716,3 +717,53 @@ def device_grant(
             float(timeout_s), len(fb),
         )
     return list(fb)
+
+
+# ---------------------------------------------------------------------------
+# numerics guard (moved here from the retired runtime.retry shim: the
+# blanket-retry module it shared is long gone — failure HANDLING and
+# failure DETECTION now live in one place)
+# ---------------------------------------------------------------------------
+
+
+def maybe_check_numerics(fetch_names, outs, what: str):
+    """Debug-mode numerics guard (``tfs.config.update(check_numerics=True)``):
+    raise FloatingPointError naming the verb, block, and fetch when an
+    output contains NaN/Inf — the role `CheckNumerics` nodes play in the
+    reference's graphs, applied to every fetch without editing the graph.
+
+    The finite-mask reduction runs ON DEVICE: every float fetch folds to
+    one boolean, the booleans fold to one scalar verdict, and the clean
+    path pays exactly ONE host sync for that scalar — the outputs
+    themselves never leave device memory. Only when the verdict fires
+    does the failure path sync per fetch to name the culprit and count
+    its bad values (also reduced on device). Off by default."""
+    from .. import config
+
+    if not config.get().check_numerics:
+        return
+    import jax.numpy as jnp
+
+    finites = []  # (name, array, all-finite scalar) per float fetch
+    for name, o in zip(fetch_names, outs):
+        arr = jnp.asarray(o)
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        finites.append((name, arr, jnp.all(jnp.isfinite(arr))))
+    if not finites:
+        return
+    verdict = (
+        finites[0][2]
+        if len(finites) == 1
+        else jnp.all(jnp.stack([f for _, _, f in finites]))
+    )
+    if bool(verdict):  # the one sync on the clean path
+        return
+    for name, arr, fin in finites:
+        if not bool(fin):
+            bad = int(jnp.sum(~jnp.isfinite(arr)))
+            raise FloatingPointError(
+                f"{what}: fetch {name!r} contains {bad} non-finite "
+                "value(s) (check_numerics is on)"
+            )
+    raise AssertionError("unreachable: verdict fired but no fetch did")

@@ -1,60 +1,15 @@
-"""Failure handling shims: classified retry + the numerics guard.
+"""DEPRECATED compat shim — everything lives in `runtime.faults` now.
 
-The blanket retry that used to live here (re-invoke N times on ANY
-exception) grew into the fault-tolerance layer in `runtime.faults`:
-errors are now CLASSIFIED (transient / resource / deterministic),
-transient retries back off exponentially with deterministic jitter, and
-deterministic errors surface after exactly one attempt instead of
-burning the whole budget. `run_with_retries` is re-exported so existing
-imports keep resolving; `maybe_check_numerics` (the CheckNumerics role
-for every verb output) still lives here.
+The blanket retry that used to live here grew into the classified
+fault-tolerance layer (`runtime.faults`, ISSUE 6), and
+`maybe_check_numerics` — the CheckNumerics role for every verb output —
+moved there too (failure handling and failure detection are one
+subsystem). This module remains only so historical imports keep
+resolving; new code should import from `runtime.faults` directly.
 """
 
 from __future__ import annotations
 
-from .faults import run_with_retries  # noqa: F401  (compat re-export)
+from .faults import maybe_check_numerics, run_with_retries  # noqa: F401
 
 __all__ = ["run_with_retries", "maybe_check_numerics"]
-
-
-def maybe_check_numerics(fetch_names, outs, what: str):
-    """Debug-mode numerics guard (``tfs.config.update(check_numerics=True)``):
-    raise FloatingPointError naming the verb, block, and fetch when an
-    output contains NaN/Inf — the role `CheckNumerics` nodes play in the
-    reference's graphs, applied to every fetch without editing the graph.
-
-    The finite-mask reduction runs ON DEVICE: every float fetch folds to
-    one boolean, the booleans fold to one scalar verdict, and the clean
-    path pays exactly ONE host sync for that scalar — the outputs
-    themselves never leave device memory. Only when the verdict fires
-    does the failure path sync per fetch to name the culprit and count
-    its bad values (also reduced on device). Off by default."""
-    from .. import config
-
-    if not config.get().check_numerics:
-        return
-    import jax.numpy as jnp
-
-    finites = []  # (name, array, all-finite scalar) per float fetch
-    for name, o in zip(fetch_names, outs):
-        arr = jnp.asarray(o)
-        if not jnp.issubdtype(arr.dtype, jnp.floating):
-            continue
-        finites.append((name, arr, jnp.all(jnp.isfinite(arr))))
-    if not finites:
-        return
-    verdict = (
-        finites[0][2]
-        if len(finites) == 1
-        else jnp.all(jnp.stack([f for _, _, f in finites]))
-    )
-    if bool(verdict):  # the one sync on the clean path
-        return
-    for name, arr, fin in finites:
-        if not bool(fin):
-            bad = int(jnp.sum(~jnp.isfinite(arr)))
-            raise FloatingPointError(
-                f"{what}: fetch {name!r} contains {bad} non-finite "
-                "value(s) (check_numerics is on)"
-            )
-    raise AssertionError("unreachable: verdict fired but no fetch did")

@@ -52,11 +52,69 @@ __all__ = [
     "batcher",
     "serve",
     "active",
+    "drain",
     "ServingHandle",
     "ServingClient",
     "ServingError",
     "reset",
 ]
+
+
+def drain(timeout_s=30.0, stop_server: bool = True) -> dict:
+    """Graceful rolling-restart drain — the serving readiness story an
+    external load balancer rolls replicas with:
+
+    1. flips the readiness flag: ``/healthz`` reports
+       ``ready: false`` / ``status: "draining"`` and every NEW
+       ``POST /serve/<endpoint>`` sheds with a typed 503 (the balancer
+       stops routing here; stragglers retry another replica);
+    2. lets in-flight batcher lanes finish: waits (up to ``timeout_s``
+       seconds; ``None`` = unbounded) for every queued request to
+       dispatch, then stops the lanes through the existing
+       `MicroBatcher.shutdown()` (which itself drains each lane
+       through one final dispatch);
+    3. unmounts the front-end and — with ``stop_server=True``, the
+       default — stops the shared process HTTP server via the
+       existing `telemetry_http.shutdown()`, so the port frees for
+       the replacement replica.
+
+    Endpoint registrations survive (a restart re-serves them with one
+    `serve()` call, which also clears the draining flag). Idempotent.
+    Returns accounting: ``{"drained": all lanes empty before shutdown,
+    "waited_s": ..., "stopped_server": ...}``."""
+    import time as _time
+
+    from . import server as _server
+    from .batcher import batcher as _the_batcher
+
+    _server.set_draining(True)
+    t0 = _time.monotonic()
+    b = _the_batcher()
+    while b.pending() > 0:
+        if timeout_s is not None and _time.monotonic() - t0 >= timeout_s:
+            break
+        _time.sleep(0.005)
+    drained = b.pending() == 0
+    b.shutdown()
+    handle = _server.active()
+    if handle is not None:
+        handle.close()
+    stopped = False
+    if stop_server:
+        from ..utils import telemetry_http as _http
+
+        stopped = _http.shutdown()
+    from ..utils.log import get_logger
+
+    get_logger("serving").info(
+        "serving drained in %.3fs (lanes empty: %s, server stopped: %s)",
+        _time.monotonic() - t0, drained, stopped,
+    )
+    return {
+        "drained": drained,
+        "waited_s": _time.monotonic() - t0,
+        "stopped_server": stopped,
+    }
 
 
 def reset() -> None:
@@ -65,6 +123,7 @@ def reset() -> None:
     `telemetry.reset()`."""
     from . import server as _server
 
+    _server.set_draining(False)
     handle = _server.active()
     if handle is not None:
         handle.close()  # unmounts AND clears the active-handle global

@@ -49,13 +49,40 @@ from typing import Dict, Optional, Tuple
 
 from ..runtime import deadline as _dl
 
-__all__ = ["serve", "active", "ServingHandle", "ARROW_CONTENT_TYPE", "PREFIX"]
+__all__ = [
+    "serve",
+    "active",
+    "draining",
+    "set_draining",
+    "ServingHandle",
+    "ARROW_CONTENT_TYPE",
+    "PREFIX",
+]
 
 PREFIX = "/serve"
 ARROW_CONTENT_TYPE = "application/vnd.apache.arrow.stream"
 
 _lock = threading.Lock()
 _handle: Optional["ServingHandle"] = None
+
+# Rolling-restart readiness (`tfs.serving.drain()`): while set, NEW
+# serving requests shed with 503 and /healthz reports ready=false, so
+# an external balancer stops routing here while in-flight batcher
+# lanes finish. Cleared by serve() (a remount is a fresh replica) and
+# serving.reset().
+_draining = threading.Event()
+
+
+def draining() -> bool:
+    """True while `tfs.serving.drain()` is shedding new requests."""
+    return _draining.is_set()
+
+
+def set_draining(on: bool) -> None:
+    if on:
+        _draining.set()
+    else:
+        _draining.clear()
 
 
 def _error_body(e: BaseException, **extra) -> bytes:
@@ -79,6 +106,19 @@ def _handle_run(
 
     rid = headers.get("X-TFS-Request-Id") or f"req-{uuid.uuid4().hex[:12]}"
     echo = {"X-TFS-Request-Id": rid}
+    if _draining.is_set():
+        # rolling restart: shed BEFORE any work — the balancer already
+        # sees ready=false on /healthz; stragglers get a typed 503
+        return 503, "application/json", json.dumps(
+            {
+                "error": "Draining",
+                "message": (
+                    "serving is draining for a rolling restart; retry "
+                    "against another replica"
+                ),
+                "draining": True,
+            }
+        ).encode(), echo
     try:
         ep = _registry.get(name)
     except KeyError as e:
@@ -154,6 +194,7 @@ def _route(method: str, path: str, headers, body: bytes):
             return _json(
                 {
                     "service": "tensorframes_tpu serving",
+                    "draining": _draining.is_set(),
                     "endpoints": _registry.endpoints(),
                     "batcher": _the_batcher().snapshot(),
                 }
@@ -222,6 +263,7 @@ def serve(
             f"cannot serve on {port} (tfs.telemetry.shutdown() first)"
         )
     _http.mount(PREFIX, _route, replace=True)
+    _draining.clear()  # a (re)mounted front-end is a ready replica
     handle = ServingHandle(srv)
     global _handle
     with _lock:

@@ -67,6 +67,9 @@ def reduce_blocks_stream(
     mesh=None,
     fold_every="auto",
     devices=None,
+    checkpoint=None,
+    checkpoint_every: Optional[int] = None,
+    resume: str = "auto",
 ):
     """Out-of-core reduce: fold an ITERATOR of frames (chunks too large to
     hold at once — the Spark-spill analogue). Chunk N+1 is produced by a
@@ -101,6 +104,25 @@ def reduce_blocks_stream(
     single equally-weighted final combine at the cost of O(#chunks)
     host memory. Pass an int to force a fold cadence, or ``None`` to
     force the single final combine.
+
+    Durable streams (``checkpoint=``, `runtime.checkpoint`): give a
+    path and the stream atomically commits its progress — a versioned
+    manifest (dataset/program/config fingerprints, per-fetch monoid
+    kinds, the contiguous-chunk WATERMARK) plus the live partial table
+    — after every ``checkpoint_every`` folded chunks (default
+    ``config.stream_checkpoint_every``), on clean `DeadlineExceeded` /
+    `Cancelled` exits, and at completion. A crash / SIGKILL /
+    preemption then resumes in a fresh process: the committed manifest
+    is validated field by field (any drift refuses loudly naming the
+    field; ``resume="ignore"`` opts into a fresh start), chunks below
+    the watermark are skipped at the `Dataset.tasks()` METADATA level
+    (never re-decoded) for an unstarted `IngestStream`, and the fold
+    is seeded with the restored partials — bit-identical to an
+    uninterrupted run for exact monoids (min/max/prod/int-sum), within
+    the documented reassociation tolerance for float sum/mean. Only
+    classifiable monoid reduces are eligible; anything else rejects
+    ``checkpoint=`` with a typed `CheckpointError`. Requires the local
+    path (no ``mesh=``).
     """
     graph, fetch_list = _api._as_graph(fetches, fetch_names)
     auto_fold = fold_every == "auto"
@@ -215,101 +237,209 @@ def reduce_blocks_stream(
     from .ingest.dataset import IngestStream
     from .ingest.pipeline import PipeStage, pipelined
 
-    if isinstance(frames, IngestStream) and not frames.started:
-        source, pipe_stages = frames.source_and_stages()
+    composable = isinstance(frames, IngestStream) and not frames.started
+
+    ckpt = None
+    watermark = 0
+    restored: List[Dict] = []
+    ds_tasks = None
+    if checkpoint is not None:
+        from .runtime.checkpoint import CheckpointError, StreamCheckpointer
+
+        if mesh is not None:
+            raise CheckpointError(
+                "checkpoint= requires the local path (no mesh= — the "
+                "mesh owns its own placement and has no per-chunk "
+                "watermark to commit)"
+            )
+        ds_fp = None
+        if composable:
+            # the dataset fingerprint AND the resume skip both work at
+            # the task-METADATA level: materializing the task list here
+            # reads only file footers, never chunk data
+            ds_tasks = frames.dataset.task_list()
+            ds_fp = frames.dataset.fingerprint(ds_tasks)
+        ckpt = StreamCheckpointer(
+            checkpoint, graph, [_base(f) for f in fetch_list],
+            checkpoint_every, resume, ds_fp,
+        )
+        ckpt.entry_gate()
+        watermark, restored = ckpt.try_resume()
+
+    if composable:
+        # resume skips committed chunks at the task level: they are
+        # never decoded again (the decode-stage counter proves it)
+        source, pipe_stages = frames.source_and_stages(
+            tasks=ds_tasks, skip=watermark
+        )
         pipe_depth = frames.depth
     else:
         # plain iterator — or an IngestStream someone already pulled
         # from, whose running pipeline must be consumed, not rebuilt
         source, pipe_stages, pipe_depth = frames, [], None
+        if watermark:
+            # a plain iterator has no metadata level: committed chunks
+            # are pulled (the producer pays their synthesis) but never
+            # transferred or dispatched
+            source = iter(frames)
+            for _ in range(watermark):
+                try:
+                    next(source)
+                except StopIteration:
+                    break
+    if watermark:
+        # device rotation continues from the committed ordinal, as if
+        # the stream had never stopped
+        stage_idx[0] = consume_idx[0] = watermark
     if local:
         pipe_stages.append(PipeStage("transfer-stage", _to_device))
 
-    partials: List[Dict] = []
-    for f in pipelined(source, pipe_stages, depth=pipe_depth):
-        chunk_dev = _chunk_device(consume_idx)
-        nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
-        if nrows == 0:
-            # Empty chunk (empty file partition / fully filtered shard):
-            # it contributes the reduction identity, i.e. nothing — skip
-            # the dispatch instead of raising "empty frame" mid-stream or
-            # emitting a partial that poisons the combine (reduce_min
-            # over 0 rows). Classification (auto_fold) waits for the
-            # first chunk that actually carries rows.
-            continue
-        if auto_fold:
-            # classify once, on the first chunk: tree-fold only graphs
-            # proven associative (sum/min/max/prod monoids); anything
-            # else keeps every partial for one exact final combine
-            auto_fold = False
-            try:
-                ov = _api._ph_overrides(graph, f, feed_dict, block_level=True)
-                s = analyze_graph(graph, fetch_list, placeholder_shapes=ov)
-                # require_direct: partials recombine through the same
-                # graph here, so an interposed transform (Sum(x*x))
-                # would be re-applied at every fold
-                comb = _chunk_combiners(
-                    graph, fetch_list, s, require_direct=True
-                )
-                if comb is not None and "mean" not in comb.values():
-                    fold_every = 64
-            except Exception:
-                pass  # conservative: no folding when classification fails
-        # per-chunk span/counters: stream chunks previously bypassed
-        # profiling entirely (only the inner verb recorded); the chunk
-        # record attributes each dispatch to the stream and carries the
-        # chunk row count
-        with record("reduce_blocks_stream.chunk", int(nrows or 0)):
-            r = _api.reduce_blocks(
-                graph, f, feed_dict, fetch_names=fetch_list,
-                executor=executor, mesh=mesh,
-                # pin the chunk's dispatch to the device its prefetch
-                # transfer targeted: compute lands where the data
-                # already is, and consecutive chunks run on different
-                # devices (compute/compute overlap, not just
-                # transfer/compute)
-                devices=[chunk_dev] if chunk_dev is not None else None,
-            )
-        partials.append(r if isinstance(r, dict) else {_base(fetch_list[0]): r})
-        if fold_every is not None and len(partials) >= fold_every:
-            with _telemetry.span("reduce_blocks_stream.fold", kind="stage"):
-                partials = [_combine(partials)]
-        elif fold_every is None and len(partials) > 1:
-            # no tree-fold will ever drain this list: spill the PREVIOUS
-            # chunk's (already computed) partial to host so unfoldable
-            # streams cost O(#chunks) host RAM — the documented bound —
-            # not device HBM. The newest partial stays on device, so the
-            # current dispatch still overlaps the next chunk's
-            # production/transfer. The spill is a real D2H sync and is
-            # accounted as one (host_sync span/counter + d2h bytes) —
-            # diagnostics previously under-reported D2H traffic on long
-            # unfoldable streams.
-            spill_src = partials[-2]
-            if any(not isinstance(v, np.ndarray) for v in spill_src.values()):
-                with _telemetry.span(
-                    "reduce_blocks_stream.spill", kind="host_sync",
-                    chunk=len(partials) - 2,
-                ):
-                    spilled = {
-                        k: np.asarray(v) for k, v in spill_src.items()
-                    }
-                record_count("host_sync")
-                if _telemetry.enabled():
-                    _telemetry.histogram_observe(
-                        "d2h_bytes",
-                        float(sum(v.nbytes for v in spilled.values())),
+    from .runtime.deadline import Cancelled, DeadlineExceeded
+
+    partials: List[Dict] = list(restored)
+    # `ordinal` counts source chunks FULLY consumed (committed ones
+    # included): the candidate watermark. Empty chunks advance it —
+    # they contribute the reduction identity, and a resume must not
+    # re-deliver them just to skip them again.
+    ordinal = watermark
+    try:
+        for f in pipelined(
+            source, pipe_stages, depth=pipe_depth, ordinal_base=watermark
+        ):
+            chunk_dev = _chunk_device(consume_idx)
+            nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
+            if nrows == 0:
+                # Empty chunk (empty file partition / fully filtered
+                # shard): it contributes the reduction identity, i.e.
+                # nothing — skip the dispatch instead of raising "empty
+                # frame" mid-stream or emitting a partial that poisons
+                # the combine (reduce_min over 0 rows). Classification
+                # (auto_fold) waits for the first chunk that actually
+                # carries rows.
+                ordinal += 1
+                continue
+            if auto_fold or (ckpt is not None and ckpt.monoids is None):
+                # classify once, on the first chunk: ONE analysis pass
+                # serves both the fold class (tree-fold only graphs
+                # proven associative — sum/min/max/prod monoids
+                # consuming their placeholder directly; anything else
+                # keeps every partial for one exact final combine) and
+                # the checkpoint eligibility gate / monoid manifest
+                comb_any = None
+                try:
+                    ov = _api._ph_overrides(
+                        graph, f, feed_dict, block_level=True
                     )
-                partials[-2] = spilled
-    if not partials:
-        raise ValueError(
-            "reduce_blocks_stream over an empty iterator (or every chunk "
-            "had zero rows)"
-        )
-    if len(partials) == 1:
-        out = partials[0]
-    else:
-        with _telemetry.span("reduce_blocks_stream.fold", kind="stage"):
-            out = _combine(partials)
+                    s = analyze_graph(
+                        graph, fetch_list, placeholder_shapes=ov
+                    )
+                    comb_any = _chunk_combiners(graph, fetch_list, s)
+                    if auto_fold:
+                        # require_direct: partials recombine through
+                        # the same graph here, so an interposed
+                        # transform (Sum(x*x)) would be re-applied at
+                        # every fold
+                        comb = _chunk_combiners(
+                            graph, fetch_list, s, require_direct=True
+                        )
+                        if comb is not None and "mean" not in comb.values():
+                            fold_every = 64
+                except Exception:
+                    pass  # conservative: no folding when classification fails
+                auto_fold = False
+                if ckpt is not None:
+                    # rejects non-classifiable reduces (typed
+                    # CheckpointError) and, on resume, refuses a
+                    # drifted monoid set / fold cadence
+                    ckpt.on_first_chunk(comb_any, fold_every)
+            # per-chunk span/counters: stream chunks previously bypassed
+            # profiling entirely (only the inner verb recorded); the chunk
+            # record attributes each dispatch to the stream and carries the
+            # chunk row count
+            with record("reduce_blocks_stream.chunk", int(nrows or 0)):
+                r = _api.reduce_blocks(
+                    graph, f, feed_dict, fetch_names=fetch_list,
+                    executor=executor, mesh=mesh,
+                    # pin the chunk's dispatch to the device its prefetch
+                    # transfer targeted: compute lands where the data
+                    # already is, and consecutive chunks run on different
+                    # devices (compute/compute overlap, not just
+                    # transfer/compute)
+                    devices=[chunk_dev] if chunk_dev is not None else None,
+                )
+            partials.append(
+                r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+            )
+            # advance the candidate watermark the moment the chunk's
+            # contribution is IN `partials`: from here on
+            # (ordinal, partials) is a committable state even if the
+            # fold below is interrupted mid-combine (a fold only
+            # reorganizes contributions, it never adds one)
+            ordinal += 1
+            if fold_every is not None and len(partials) >= fold_every:
+                with _telemetry.span(
+                    "reduce_blocks_stream.fold", kind="stage"
+                ):
+                    partials = [_combine(partials)]
+            elif fold_every is None and len(partials) > 1:
+                # no tree-fold will ever drain this list: spill the
+                # PREVIOUS chunk's (already computed) partial to host so
+                # unfoldable streams cost O(#chunks) host RAM — the
+                # documented bound — not device HBM. The newest partial
+                # stays on device, so the current dispatch still
+                # overlaps the next chunk's production/transfer. The
+                # spill is a real D2H sync and is accounted as one
+                # (host_sync span/counter + d2h bytes) — diagnostics
+                # previously under-reported D2H traffic on long
+                # unfoldable streams.
+                spill_src = partials[-2]
+                if any(
+                    not isinstance(v, np.ndarray)
+                    for v in spill_src.values()
+                ):
+                    with _telemetry.span(
+                        "reduce_blocks_stream.spill", kind="host_sync",
+                        chunk=len(partials) - 2,
+                    ):
+                        spilled = {
+                            k: np.asarray(v) for k, v in spill_src.items()
+                        }
+                    record_count("host_sync")
+                    if _telemetry.enabled():
+                        _telemetry.histogram_observe(
+                            "d2h_bytes",
+                            float(
+                                sum(v.nbytes for v in spilled.values())
+                            ),
+                        )
+                    partials[-2] = spilled
+            if ckpt is not None:
+                # the commit point: chunk `ordinal - 1` is fully folded
+                # into `partials`, so (ordinal, partials) is exactly the
+                # state an uninterrupted run holds here
+                ckpt.note_chunk_folded(ordinal, partials)
+        if not partials:
+            raise ValueError(
+                "reduce_blocks_stream over an empty iterator (or every "
+                "chunk had zero rows)"
+            )
+        if len(partials) == 1:
+            out = partials[0]
+        else:
+            with _telemetry.span("reduce_blocks_stream.fold", kind="stage"):
+                out = _combine(partials)
+    except (DeadlineExceeded, Cancelled) as e:
+        # clean cooperative exits commit the progress so far — the
+        # budget bought (ordinal - watermark) folded chunks; a resume
+        # picks up from the committed watermark instead of chunk zero
+        if ckpt is not None:
+            ckpt.on_interrupt(e, ordinal, partials)
+        raise
+    if ckpt is not None:
+        # completion commit: watermark = every chunk, so an identical
+        # re-run resumes to a no-op (restored partials combine; zero
+        # chunks re-decode)
+        ckpt.finalize(ordinal, partials)
     if len(fetch_list) == 1:
         return out[_base(fetch_list[0])]
     return out

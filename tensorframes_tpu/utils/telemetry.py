@@ -486,6 +486,7 @@ _HISTOGRAM_FAMILIES: Dict[str, str] = {
     # and made their quantiles unreadable
     "serve_batch_rows": "rows",
     "serve_batch_fill": "rows",
+    "checkpoint_write_seconds": "seconds",
 }
 
 
@@ -934,6 +935,12 @@ _PROM_HELP: Dict[str, str] = {
     "device_grant_timeouts": "Device acquisitions abandoned by watchdog",
     "deadline_exceeded": "Verb deadline expiries by verb",
     "verbs_shed": "Verbs rejected by admission control",
+    "checkpoint_commits": "Durable-stream checkpoint commits",
+    "checkpoint_resumes": "Streams resumed from a durable checkpoint",
+    "checkpoint_chunks_skipped": (
+        "Committed chunks skipped (never re-decoded) by resumed streams"
+    ),
+    "checkpoint_write_seconds": "Durable-stream checkpoint commit latency",
     "autotune_adjustments": "Knob adjustments applied by the autotuner",
     "admission_wait_seconds": "Time spent queued for a verb slot",
     "admission_queue_depth": "Verbs queued for admission right now",
@@ -1169,6 +1176,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["autotune"] = _autotune.state()
     except Exception as e:
         data["autotune"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # durable streams: checkpoint/resume accounting ---------------------
+    try:
+        from ..runtime import checkpoint as _checkpoint
+
+        data["checkpoint"] = _checkpoint.state()
+    except Exception as e:
+        data["checkpoint"] = {"error": f"{type(e).__name__}: {e}"}
 
     # executor + recompile-storm signal ---------------------------------
     try:
@@ -1468,6 +1483,36 @@ def _render_diagnostics(data: Dict) -> str:
                     f"{dec.get('current')} -> {dec.get('proposed')} "
                     f"[{dec.get('outcome')}]"
                 )
+
+    # durable streams: checkpoint/resume accounting ---------------------
+    ck = data.get("checkpoint", {})
+    if ck and "error" not in ck and (
+        ck.get("commits") or ck.get("resumes") or ck.get("ignored")
+    ):
+        lines.append("")
+        lines.append(
+            f"durable streams: {ck.get('commits', 0)} commit(s), "
+            f"{ck.get('resumes', 0)} resume(s), "
+            f"{ck.get('chunks_skipped', 0)} committed chunk(s) skipped"
+            + (
+                f", {ck['ignored']} checkpoint(s) ignored"
+                if ck.get("ignored") else ""
+            )
+        )
+        lc = ck.get("last_commit")
+        if lc:
+            lines.append(
+                f"  last commit: {lc['path']} watermark={lc['watermark']}"
+                f" partials={lc['partials']} "
+                f"{_fmt_bytes(lc['bytes'])} in "
+                f"{lc['write_seconds'] * 1e3:.1f}ms"
+            )
+        lr = ck.get("last_resume")
+        if lr:
+            lines.append(
+                f"  last resume: {lr['path']} "
+                f"watermark={lr['watermark']} partials={lr['partials']}"
+            )
 
     # executor + recompile-storm signal ---------------------------------
     if "executor_error" in data:

@@ -108,6 +108,8 @@ def _json_default(o):
 
 
 def _healthz_payload() -> dict:
+    import sys as _sys
+
     from ..runtime.deadline import controller
     from ..runtime.scheduler import health_overview
 
@@ -116,8 +118,25 @@ def _healthz_payload() -> dict:
     circuits = any(r.get("state") not in (None, "closed") for r in rows)
     overloaded = bool(admission.get("overloaded"))
     degraded = circuits or overloaded
+    # Rolling-restart readiness (`tfs.serving.drain()`): an external
+    # balancer keys on `ready` to stop routing to a draining replica.
+    # Read the flag only if the serving module is already loaded — a
+    # pure-telemetry process must not import the serving stack for a
+    # health scrape.
+    draining = False
+    srv = _sys.modules.get("tensorframes_tpu.serving.server")
+    if srv is not None:
+        try:
+            draining = bool(srv.draining())
+        except Exception:
+            draining = False
     return {
-        "status": "degraded" if degraded else "ok",
+        "status": (
+            "draining" if draining
+            else ("degraded" if degraded else "ok")
+        ),
+        "ready": not draining,
+        "draining": draining,
         "degraded": degraded,
         "overloaded": overloaded,
         "devices": rows,

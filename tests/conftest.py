@@ -39,7 +39,13 @@ def _reset_telemetry():
     bleed into the next test's scheduling."""
     yield
     from tensorframes_tpu import config, serving
-    from tensorframes_tpu.runtime import autotune, costmodel, deadline, faults
+    from tensorframes_tpu.runtime import (
+        autotune,
+        checkpoint,
+        costmodel,
+        deadline,
+        faults,
+    )
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
 
@@ -51,3 +57,4 @@ def _reset_telemetry():
     device_health().reset()
     costmodel.reset()
     deadline.reset()
+    checkpoint.reset_state()  # durable-stream accounting never leaks

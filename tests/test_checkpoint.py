@@ -1,0 +1,877 @@
+"""Durable streams: checkpoint/resume for out-of-core reductions (ISSUE 13).
+
+Covers the `runtime.checkpoint` store (atomic commit, corruption
+detection, schema gating), the `reduce_blocks_stream(checkpoint=)`
+protocol (eligibility gate, periodic + clean-exit commits, resume
+validation with loud drift refusal, metadata-level chunk skipping),
+THE crash acceptance case (SIGKILL mid-stream, fresh-interpreter
+resume, bit-identical for exact monoids, >= watermark chunks never
+re-decoded), the serving `drain()` readiness satellite, and the
+retired `runtime.retry` shim.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config
+from tensorframes_tpu import io as tio
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.graph import builder as dsl
+from tensorframes_tpu.runtime import checkpoint as ckpt_mod
+from tensorframes_tpu.runtime.checkpoint import (
+    CheckpointError,
+    CheckpointStore,
+    MAGIC,
+    SCHEMA_VERSION,
+)
+from tensorframes_tpu.testing import faults as chaos
+from tensorframes_tpu.utils import telemetry
+
+
+# ---------------------------------------------------------------------------
+# fixtures / helpers
+# ---------------------------------------------------------------------------
+
+
+def _write_int_shards(root, shards=4, rows=64, blocks=2, seed=0):
+    """One Parquet shard per entry; int64 column for exact-monoid
+    bit-identity across runs and processes. Returns all rows."""
+    rng = np.random.RandomState(seed)
+    parts = []
+    for i in range(shards):
+        x = rng.randint(0, 100000, size=rows).astype(np.int64)
+        parts.append(x)
+        df = TensorFrame.from_dict({"x": x}, num_blocks=blocks)
+        tio.write_parquet(df, str(root / f"shard-{i:03d}.parquet"))
+    return np.concatenate(parts)
+
+
+def _probe():
+    return TensorFrame.from_dict({"x": np.arange(2).astype(np.int64)})
+
+
+def _xi():
+    return tfs.block(_probe(), "x", tf_name="x_input")
+
+
+def _sum_fetch():
+    return dsl.reduce_sum(_xi(), axes=[0]).named("x")
+
+
+# multi-fetch reduces follow the x -> x_input combine convention: one
+# placeholder per fetch, each re-fed its partial at the combine, all
+# mapped onto the one data column via feed_dict
+_FEED = {"s_input": "x", "mn_input": "x", "mx_input": "x"}
+
+
+def _monoid_fetches():
+    probe = _probe()
+    return [
+        dsl.reduce_sum(
+            tfs.block(probe, "x", tf_name="s_input"), axes=[0]
+        ).named("s"),
+        dsl.reduce_min(
+            tfs.block(probe, "x", tf_name="mn_input"), axes=[0]
+        ).named("mn"),
+        dsl.reduce_max(
+            tfs.block(probe, "x", tf_name="mx_input"), axes=[0]
+        ).named("mx"),
+    ]
+
+
+def _decode_count():
+    return sum(
+        v
+        for (name, labels), v in telemetry.labeled_counters().items()
+        if name == "ingest_chunks" and dict(labels).get("stage") == "decode"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the store: atomic commit + corruption safety
+# ---------------------------------------------------------------------------
+
+
+class TestStore:
+    def test_commit_load_round_trip(self, tmp_path):
+        store = CheckpointStore(tmp_path / "ck")
+        payload = b"payload-bytes" * 100
+        store.commit({"watermark": 7, "foo": "bar"}, payload)
+        manifest, loaded = store.load()
+        assert loaded == payload
+        assert manifest["watermark"] == 7
+        assert manifest["foo"] == "bar"
+        assert manifest["schema_version"] == SCHEMA_VERSION
+        assert manifest["payload_len"] == len(payload)
+
+    def test_commit_is_atomic_no_tmp_left(self, tmp_path):
+        store = CheckpointStore(tmp_path / "ck")
+        store.commit({"watermark": 1}, b"abc")
+        store.commit({"watermark": 2}, b"def")  # replace, not append
+        assert [p.name for p in tmp_path.iterdir()] == ["ck"]
+        manifest, payload = store.load()
+        assert manifest["watermark"] == 2 and payload == b"def"
+
+    def test_commit_reaps_stale_tmp_from_dead_pid_only(self, tmp_path):
+        # a SIGKILL inside an earlier commit strands `<path>.tmp.<pid>`;
+        # the next commit reaps siblings whose writer pid is DEAD — but
+        # leaves a LIVE writer's temp alone (a preempted-but-running
+        # stream racing its replacement must lose last-writer-wins,
+        # not crash on a vanished temp file)
+        dead_pid = subprocess.Popen([sys.executable, "-c", ""])
+        dead_pid.wait()
+        (tmp_path / f"ck.tmp.{dead_pid.pid}").write_bytes(b"orphan" * 1000)
+        live_pid = os.getppid()  # pytest's parent: certainly alive
+        (tmp_path / f"ck.tmp.{live_pid}").write_bytes(b"live")
+        store = CheckpointStore(tmp_path / "ck")
+        store.commit({"watermark": 1}, b"abc")
+        assert sorted(p.name for p in tmp_path.iterdir()) == [
+            "ck", f"ck.tmp.{live_pid}",
+        ]
+
+    def test_truncated_file_refused(self, tmp_path):
+        store = CheckpointStore(tmp_path / "ck")
+        store.commit({"watermark": 3}, b"x" * 4096)
+        blob = (tmp_path / "ck").read_bytes()
+        (tmp_path / "ck").write_bytes(blob[: len(blob) // 2])
+        with pytest.raises(CheckpointError) as ei:
+            store.load()
+        assert ei.value.kind == "corrupt"
+
+    def test_garbled_payload_refused_by_checksum(self, tmp_path):
+        store = CheckpointStore(tmp_path / "ck")
+        store.commit({"watermark": 3}, b"x" * 4096)
+        blob = bytearray((tmp_path / "ck").read_bytes())
+        blob[-100] ^= 0xFF  # flip one payload byte; framing intact
+        (tmp_path / "ck").write_bytes(bytes(blob))
+        with pytest.raises(CheckpointError) as ei:
+            store.load()
+        assert ei.value.kind == "corrupt"
+        assert "checksum" in str(ei.value)
+
+    def test_bad_magic_refused(self, tmp_path):
+        (tmp_path / "ck").write_bytes(b"NOTACKPT" + b"\0" * 64)
+        with pytest.raises(CheckpointError) as ei:
+            CheckpointStore(tmp_path / "ck").load()
+        assert ei.value.kind == "corrupt"
+
+    def test_stale_schema_version_refused(self, tmp_path):
+        # hand-craft a well-formed file whose manifest claims a future
+        # schema generation: framing and checksum are VALID, so the
+        # refusal must come from the version gate, naming the field
+        import hashlib
+
+        payload = b"future-payload"
+        manifest = {
+            "schema_version": SCHEMA_VERSION + 1,
+            "payload_len": len(payload),
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "watermark": 5,
+        }
+        mbytes = json.dumps(manifest, sort_keys=True).encode()
+        blob = (
+            MAGIC + struct.pack(">Q", len(mbytes)) + mbytes
+            + struct.pack(">Q", len(payload)) + payload
+        )
+        (tmp_path / "ck").write_bytes(blob)
+        with pytest.raises(CheckpointError) as ei:
+            CheckpointStore(tmp_path / "ck").load()
+        assert ei.value.kind == "drift"
+        assert ei.value.field == "schema_version"
+
+
+# ---------------------------------------------------------------------------
+# eligibility + argument validation
+# ---------------------------------------------------------------------------
+
+
+class TestEligibility:
+    def test_non_classifiable_reduce_rejected_at_entry(self, tmp_path):
+        _write_int_shards(tmp_path, shards=2)
+        # an elementwise graph (no monoid reduce root) cannot commit
+        # resumable partials: typed refusal BEFORE any chunk decodes
+        xi = _xi()
+        bad = dsl.mul(xi, xi).named("y")
+        telemetry.reset()
+        with pytest.raises(CheckpointError) as ei:
+            tfs.reduce_blocks_stream(
+                bad,
+                tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(tmp_path / "ck"),
+            )
+        assert ei.value.kind == "ineligible"
+        assert _decode_count() == 0  # entry gate fired pre-pipeline
+        assert not (tmp_path / "ck").exists()
+
+    def test_bad_checkpoint_every_and_resume_values(self, tmp_path):
+        _write_int_shards(tmp_path, shards=1)
+        with pytest.raises(CheckpointError):
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(tmp_path / "ck"), checkpoint_every=0,
+            )
+        with pytest.raises(CheckpointError):
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(tmp_path / "ck"), resume="maybe",
+            )
+
+    def test_mesh_rejected_with_checkpoint(self, tmp_path):
+        _write_int_shards(tmp_path, shards=1)
+        with pytest.raises(CheckpointError):
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(tmp_path / "ck"), mesh=object(),
+            )
+
+
+# ---------------------------------------------------------------------------
+# commit / resume protocol (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestCommitResume:
+    def test_full_run_bit_identical_and_commits(self, tmp_path):
+        allx = _write_int_shards(tmp_path, shards=4)
+        ck = tmp_path / "ck"
+        plain = tfs.reduce_blocks_stream(
+            _monoid_fetches(), tfs.stream_dataset(str(tmp_path)), _FEED
+        )
+        ckpt_mod.reset_state()
+        out = tfs.reduce_blocks_stream(
+            _monoid_fetches(), tfs.stream_dataset(str(tmp_path)), _FEED,
+            checkpoint=str(ck), checkpoint_every=2,
+        )
+        for k in ("s", "mn", "mx"):
+            assert np.array_equal(np.asarray(out[k]), np.asarray(plain[k]))
+        assert int(np.asarray(out["s"])) == int(allx.sum())
+        st = ckpt_mod.state()
+        assert st["commits"] >= 2
+        assert st["last_commit"]["watermark"] == 8  # 4 shards x 2 blocks
+        assert ck.exists()
+
+    def test_resume_of_completed_run_decodes_nothing(self, tmp_path):
+        allx = _write_int_shards(tmp_path, shards=3)
+        ck = tmp_path / "ck"
+        tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck), checkpoint_every=1,
+        )
+        telemetry.reset()
+        ckpt_mod.reset_state()
+        out = tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck), checkpoint_every=1,
+        )
+        assert int(np.asarray(out)) == int(allx.sum())
+        assert _decode_count() == 0  # task-metadata-level skip
+        st = ckpt_mod.state()
+        assert st["resumes"] == 1
+        assert st["chunks_skipped"] == 6
+        assert st["commits"] == 0  # nothing new folded -> no write
+
+    def test_deadline_interrupt_commits_then_resume_bit_identical(
+        self, tmp_path
+    ):
+        _write_int_shards(tmp_path, shards=6, rows=64)
+        ck = tmp_path / "ck"
+        fetches = _monoid_fetches()
+        # warm the per-chunk programs so the interrupted run's budget is
+        # spent streaming, not compiling
+        plain = tfs.reduce_blocks_stream(
+            fetches, tfs.stream_dataset(str(tmp_path)), _FEED
+        )
+        total_chunks = 12  # 6 shards x 2 blocks
+        with chaos.inject_stage(
+            stage="decode", nth=[8], fault="hang", delay_s=30.0
+        ):
+            with pytest.raises(tfs.DeadlineExceeded) as ei:
+                tfs.reduce_blocks_stream(
+                    fetches, tfs.stream_dataset(str(tmp_path)), _FEED,
+                    checkpoint=str(ck), checkpoint_every=1,
+                    timeout_s=2.5,
+                )
+        # the clean deadline exit committed, and stamped the watermark
+        wm = ei.value.tfs_checkpoint_watermark
+        assert ei.value.tfs_checkpoint_path == str(ck)
+        assert wm is not None and 1 <= wm <= 8
+        manifest, _ = CheckpointStore(ck).load()
+        assert manifest["watermark"] == wm
+        assert manifest["monoids"] == {"s": "sum", "mn": "min", "mx": "max"}
+        telemetry.reset()
+        out = tfs.reduce_blocks_stream(
+            fetches, tfs.stream_dataset(str(tmp_path)), _FEED,
+            checkpoint=str(ck), checkpoint_every=1,
+        )
+        for k in ("s", "mn", "mx"):
+            assert np.array_equal(np.asarray(out[k]), np.asarray(plain[k]))
+        # committed chunks were skipped at the metadata level: the
+        # resumed run decoded at most (total - watermark) chunks
+        assert _decode_count() <= total_chunks - wm
+
+    def test_plain_iterator_checkpoint_and_resume(self, tmp_path):
+        rng = np.random.RandomState(3)
+        chunks = [
+            rng.randint(0, 1000, size=32).astype(np.int64) for _ in range(5)
+        ]
+        frames = lambda: [  # noqa: E731 - tiny chunk factory
+            TensorFrame.from_dict({"x": c}) for c in chunks
+        ]
+        expected = int(np.concatenate(chunks).sum())
+        ck = tmp_path / "ck"
+        out = tfs.reduce_blocks_stream(
+            _sum_fetch(), frames(), checkpoint=str(ck), checkpoint_every=2
+        )
+        assert int(np.asarray(out)) == expected
+        manifest, _ = CheckpointStore(ck).load()
+        assert manifest["dataset_fingerprint"] is None  # no metadata level
+        # a re-run resumes: skipped chunks are pulled but never dispatched
+        ckpt_mod.reset_state()
+        out2 = tfs.reduce_blocks_stream(
+            _sum_fetch(), frames(), checkpoint=str(ck), checkpoint_every=2
+        )
+        assert int(np.asarray(out2)) == expected
+        st = ckpt_mod.state()
+        assert st["resumes"] == 1
+        # "skipped" means never re-decoded — only the dataset
+        # (task-metadata) path earns it; a plain iterator re-pulls
+        # committed chunks from the producer
+        assert st["chunks_skipped"] == 0
+
+    def test_rank2_partials_refused_at_first_fold(self, tmp_path):
+        # classifiable monoid but rank-2 partials: the payload gate
+        # fires at the FIRST fold, not checkpoint_every chunks later
+        chunks = [
+            TensorFrame.from_dict({"x": np.ones((8, 2, 2))})
+            for _ in range(3)
+        ]
+        probe = TensorFrame.from_dict({"x": np.ones((2, 2, 2))})
+        fetch = dsl.reduce_sum(
+            tfs.block(probe, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        with pytest.raises(CheckpointError) as ei:
+            tfs.reduce_blocks_stream(
+                fetch, iter(chunks),
+                checkpoint=str(tmp_path / "ck"), checkpoint_every=100,
+            )
+        assert ei.value.field == "x"
+        assert "rank-2" in str(ei.value)
+        assert not (tmp_path / "ck").exists()
+
+    def test_failed_final_commit_returns_the_result(
+        self, tmp_path, monkeypatch
+    ):
+        # the completed result already exists in memory: a failed
+        # FINAL commit is logged, never raised (durability bookkeeping
+        # must not destroy the thing it protects)
+        allx = _write_int_shards(tmp_path, shards=2)
+        # checkpoint_every > #chunks: the only commit is finalize's
+        monkeypatch.setattr(
+            CheckpointStore, "commit",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                CheckpointError("disk full", path=self.path)
+            ),
+        )
+        out = tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(tmp_path / "ck"), checkpoint_every=100,
+        )
+        assert int(np.asarray(out)) == int(allx.sum())
+        assert not (tmp_path / "ck").exists()
+
+    def test_zero_row_chunks_advance_watermark(self, tmp_path):
+        rng = np.random.RandomState(4)
+        xs = [rng.randint(0, 9, size=16).astype(np.int64) for _ in range(3)]
+        empty = TensorFrame.from_dict({"x": np.zeros(0, np.int64)})
+        frames = lambda: [  # noqa: E731
+            TensorFrame.from_dict({"x": xs[0]}),
+            empty,
+            TensorFrame.from_dict({"x": xs[1]}),
+            empty,
+            TensorFrame.from_dict({"x": xs[2]}),
+        ]
+        ck = tmp_path / "ck"
+        out = tfs.reduce_blocks_stream(
+            _sum_fetch(), frames(), checkpoint=str(ck), checkpoint_every=1
+        )
+        assert int(np.asarray(out)) == int(np.concatenate(xs).sum())
+        manifest, _ = CheckpointStore(ck).load()
+        # empties contribute the identity but still advance the
+        # contiguous watermark past the last FOLDED chunk
+        assert manifest["watermark"] == 5
+        out2 = tfs.reduce_blocks_stream(
+            _sum_fetch(), frames(), checkpoint=str(ck), checkpoint_every=1
+        )
+        assert int(np.asarray(out2)) == int(np.concatenate(xs).sum())
+
+    def test_float_sum_within_tolerance(self, tmp_path):
+        rng = np.random.RandomState(5)
+        for i in range(3):
+            df = TensorFrame.from_dict(
+                {"x": rng.rand(128).astype(np.float32)}, num_blocks=2
+            )
+            tio.write_parquet(df, str(tmp_path / f"s-{i}.parquet"))
+        probe = TensorFrame.from_dict(
+            {"x": np.arange(2, dtype=np.float32)}
+        )
+        fetch = dsl.reduce_sum(
+            tfs.block(probe, "x", tf_name="x_input"), axes=[0]
+        ).named("x")
+        plain = tfs.reduce_blocks_stream(
+            fetch, tfs.stream_dataset(str(tmp_path))
+        )
+        out = tfs.reduce_blocks_stream(
+            fetch, tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(tmp_path / "ck"), checkpoint_every=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(plain), rtol=1e-5
+        )
+
+    def test_config_knob_default_cadence(self, tmp_path):
+        _write_int_shards(tmp_path, shards=2)
+        with config.override(stream_checkpoint_every=1):
+            ckpt_mod.reset_state()
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(tmp_path / "ck"),
+            )
+            assert ckpt_mod.state()["commits"] == 4  # every fold
+
+
+# ---------------------------------------------------------------------------
+# drift refusal (loud, field-named) + resume="ignore"
+# ---------------------------------------------------------------------------
+
+
+class TestDriftRefusal:
+    def _committed(self, tmp_path, shards=3):
+        _write_int_shards(tmp_path, shards=shards)
+        ck = tmp_path / "ck"
+        tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck), checkpoint_every=1,
+        )
+        return ck
+
+    def test_drifted_dataset_refused(self, tmp_path):
+        ck = self._committed(tmp_path)
+        # the dataset grows a shard after the commit
+        df = TensorFrame.from_dict(
+            {"x": np.arange(16).astype(np.int64)}, num_blocks=2
+        )
+        tio.write_parquet(df, str(tmp_path / "shard-zzz.parquet"))
+        with pytest.raises(CheckpointError) as ei:
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(ck),
+            )
+        assert ei.value.kind == "drift"
+        assert ei.value.field == "dataset_fingerprint"
+        assert "dataset_fingerprint" in str(ei.value)
+
+    def test_drifted_program_refused(self, tmp_path):
+        ck = self._committed(tmp_path)
+        # same fetch name, different reduce: only the PROGRAM drifted
+        other = dsl.reduce_min(_xi(), axes=[0]).named("x")
+        with pytest.raises(CheckpointError) as ei:
+            tfs.reduce_blocks_stream(
+                other, tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(ck),
+            )
+        assert ei.value.field == "program_fingerprint"
+
+    def test_drifted_config_refused(self, tmp_path):
+        ck = self._committed(tmp_path)
+        with config.override(shape_bucket_growth=3.5):
+            with pytest.raises(CheckpointError) as ei:
+                tfs.reduce_blocks_stream(
+                    _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                    checkpoint=str(ck),
+                )
+        assert ei.value.field == "config_digest"
+
+    def test_corrupt_checkpoint_refused_not_silently_restarted(
+        self, tmp_path
+    ):
+        ck = self._committed(tmp_path)
+        blob = ck.read_bytes()
+        ck.write_bytes(blob[: len(blob) - 32])
+        with pytest.raises(CheckpointError) as ei:
+            tfs.reduce_blocks_stream(
+                _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+                checkpoint=str(ck),
+            )
+        assert ei.value.kind == "corrupt"
+
+    def test_resume_ignore_restarts_from_zero(self, tmp_path):
+        allx = _write_int_shards(tmp_path, shards=3)
+        ck = tmp_path / "ck"
+        ck.write_bytes(b"garbage that is definitely not a checkpoint")
+        ckpt_mod.reset_state()
+        out = tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck), checkpoint_every=1, resume="ignore",
+        )
+        assert int(np.asarray(out)) == int(allx.sum())
+        st = ckpt_mod.state()
+        assert st["ignored"] == 1 and st["resumes"] == 0
+        # the fresh run overwrote the garbage with a valid checkpoint
+        manifest, _ = CheckpointStore(ck).load()
+        assert manifest["watermark"] == 6
+
+
+# ---------------------------------------------------------------------------
+# THE crash acceptance case: SIGKILL mid-stream, fresh-interpreter resume
+# ---------------------------------------------------------------------------
+
+_CHILD = textwrap.dedent(
+    """
+    import json, os, sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.graph import builder as dsl
+    from tensorframes_tpu.testing import faults as chaos
+    from tensorframes_tpu.utils import telemetry
+
+    root, ck, delay_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+
+    probe = TensorFrame.from_dict({"x": np.arange(2).astype(np.int64)})
+    fetches = [
+        dsl.reduce_sum(
+            tfs.block(probe, "x", tf_name="s_input"), axes=[0]
+        ).named("s"),
+        dsl.reduce_min(
+            tfs.block(probe, "x", tf_name="mn_input"), axes=[0]
+        ).named("mn"),
+        dsl.reduce_max(
+            tfs.block(probe, "x", tf_name="mx_input"), axes=[0]
+        ).named("mx"),
+    ]
+    feed = {"s_input": "x", "mn_input": "x", "mx_input": "x"}
+    kw = dict(checkpoint=ck, checkpoint_every=1) if ck else {}
+    if delay_s > 0:
+        # slow every decode so the parent can SIGKILL between commits
+        ctx = chaos.inject_stage(
+            stage="decode", rate=1.0, fault="hang", delay_s=delay_s
+        )
+    else:
+        import contextlib
+        ctx = contextlib.nullcontext()
+    with ctx:
+        out = tfs.reduce_blocks_stream(
+            fetches, tfs.stream_dataset(root), feed, **kw
+        )
+    decodes = sum(
+        v
+        for (name, labels), v in telemetry.labeled_counters().items()
+        if name == "ingest_chunks" and dict(labels).get("stage") == "decode"
+    )
+    print("RESULT " + json.dumps({
+        "s": int(np.asarray(out["s"])),
+        "mn": int(np.asarray(out["mn"])),
+        "mx": int(np.asarray(out["mx"])),
+        "decodes": int(decodes),
+    }))
+    """
+)
+
+
+class TestCrashResume:
+    def test_sigkill_mid_stream_fresh_interpreter_resume(self, tmp_path):
+        """SIGKILL a checkpointed streaming reduce after >= 1 commit;
+        resume in a FRESH interpreter; the result is bit-identical to
+        an uninterrupted run for min/max/int-sum and at least the
+        watermark's chunks are never re-decoded (ingest counters)."""
+        allx = _write_int_shards(tmp_path, shards=8, rows=256, blocks=1)
+        total_chunks = 8
+        ck = str(tmp_path / "ck")
+        child = tmp_path / "child.py"
+        child.write_text(_CHILD)
+        repo_root = os.path.dirname(os.path.dirname(tfs.__file__))
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.pathsep.join(
+                p
+                for p in (repo_root, os.environ.get("PYTHONPATH"))
+                if p
+            ),
+        )
+
+        # 1) the doomed run: every decode slowed so commits land between
+        #    kills deterministically enough to catch mid-stream
+        proc = subprocess.Popen(
+            [sys.executable, str(child), str(tmp_path), ck, "0.4"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        store = CheckpointStore(ck)
+        watermark = 0
+        deadline = time.monotonic() + 120.0
+        try:
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    break
+                if store.exists():
+                    try:
+                        manifest, _ = store.load()
+                    except CheckpointError:
+                        pass  # raced the atomic replace; retry
+                    else:
+                        watermark = int(manifest["watermark"])
+                        if 1 <= watermark < total_chunks:
+                            break
+                time.sleep(0.02)
+            assert proc.poll() is None, (
+                "child finished before it could be killed mid-stream: "
+                + repr(proc.communicate())
+            )
+            assert 1 <= watermark < total_chunks
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+            assert proc.returncode == -signal.SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        # the checkpoint the dead process left is valid and committed
+        manifest, _ = store.load()
+        watermark = int(manifest["watermark"])
+        assert watermark >= 1
+
+        # 2) fresh-interpreter resume, full speed
+        out = subprocess.run(
+            [sys.executable, str(child), str(tmp_path), ck, "0"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        line = [
+            ln for ln in out.stdout.splitlines() if ln.startswith("RESULT ")
+        ][-1]
+        resumed = json.loads(line[len("RESULT "):])
+
+        # bit-identical to the uninterrupted ground truth (computed
+        # here in-process: int monoids are exact across interpreters)
+        assert resumed["s"] == int(allx.sum())
+        assert resumed["mn"] == int(allx.min())
+        assert resumed["mx"] == int(allx.max())
+        # >= watermark chunks never re-decoded, asserted via the
+        # resumed interpreter's own ingest stage counters
+        assert resumed["decodes"] <= total_chunks - watermark
+
+
+# ---------------------------------------------------------------------------
+# serving drain (rolling-restart readiness satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestServingDrain:
+    def _register(self):
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        x = dsl.placeholder(
+            ScalarType.float32, shape=Shape((None,)), name="x"
+        )
+        fetch = (x * dsl.constant(np.float32(2.0))).named("y")
+        tfs.serving.register("ckdrain", fetch, {"x": "float32"})
+
+    def test_drain_flips_readiness_sheds_503_then_shuts_down(self):
+        import urllib.request
+        from urllib.error import HTTPError, URLError
+
+        from tensorframes_tpu.serving import ServingClient, ServingError
+        from tensorframes_tpu.serving import server as srv
+
+        self._register()
+        handle = tfs.serving.serve(port=0)
+        base = f"http://{handle.host}:{handle.port}"
+        try:
+            hz = json.loads(
+                urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+            )
+            assert hz["ready"] is True and hz["draining"] is False
+            client = ServingClient(handle.url)
+            req = TensorFrame.from_dict(
+                {"x": np.arange(4, dtype=np.float32)}
+            )
+            out = client.run("ckdrain", req)
+            np.testing.assert_array_equal(
+                np.asarray(out.column("y").values),
+                np.arange(4, dtype=np.float32) * 2,
+            )
+            # flag alone (routes still mounted): new requests shed 503
+            # and /healthz advertises not-ready, status "draining"
+            srv.set_draining(True)
+            with pytest.raises(ServingError) as ei:
+                client.run("ckdrain", req)
+            assert ei.value.status == 503
+            hz = json.loads(
+                urllib.request.urlopen(f"{base}/healthz", timeout=5).read()
+            )
+            assert hz["ready"] is False
+            assert hz["draining"] is True
+            assert hz["status"] == "draining"
+            srv.set_draining(False)
+
+            # the full drain: lanes finish, front-end unmounts, the
+            # shared HTTP server stops (port frees for the replacement)
+            res = tfs.serving.drain(timeout_s=10.0, stop_server=True)
+            assert res["drained"] is True
+            assert res["stopped_server"] is True
+            assert srv.draining() is True
+            with pytest.raises((URLError, HTTPError, OSError)):
+                urllib.request.urlopen(f"{base}/healthz", timeout=1)
+            # endpoint registrations survive a drain (the restart story)
+            assert any(
+                e["name"] == "ckdrain" for e in tfs.serving.endpoints()
+            )
+        finally:
+            tfs.serving.reset()
+            from tensorframes_tpu.utils import telemetry_http
+
+            telemetry_http.shutdown()
+
+    def test_reset_and_serve_clear_draining(self):
+        from tensorframes_tpu.serving import server as srv
+
+        srv.set_draining(True)
+        tfs.serving.reset()
+        assert srv.draining() is False
+
+
+# ---------------------------------------------------------------------------
+# satellites: retired retry shim, pipeline ordinal base, telemetry surface
+# ---------------------------------------------------------------------------
+
+
+class TestSatellites:
+    def test_retry_shim_reexports_faults_objects(self):
+        from tensorframes_tpu.runtime import faults, retry
+
+        assert retry.maybe_check_numerics is faults.maybe_check_numerics
+        assert retry.run_with_retries is faults.run_with_retries
+        assert set(retry.__all__) == {
+            "run_with_retries", "maybe_check_numerics",
+        }
+
+    def test_pipeline_ordinal_base_stamps_global_index(self):
+        from tensorframes_tpu.ingest import PipeStage, pipelined
+
+        def boom(i):
+            if i == 42:
+                raise ValueError("chunk body failure")
+            return i
+
+        # a resumed pipeline re-enters at its watermark: the failure at
+        # the third post-resume item must name GLOBAL ordinal 42
+        with pytest.raises(ValueError) as ei:
+            list(
+                pipelined(
+                    [40, 41, 42, 43],
+                    [PipeStage("body", boom)],
+                    ordinal_base=40,
+                )
+            )
+        assert ei.value.tfs_chunk_index == 42
+
+    def test_serial_mode_ordinal_base(self):
+        from tensorframes_tpu.ingest import PipeStage, pipelined
+
+        seen = []
+
+        def record_ordinal(item):
+            return item
+
+        with config.override(ingest_pipeline=False):
+            with pytest.raises(ValueError) as ei:
+                for _ in pipelined(
+                    iter(
+                        x if x < 12 else (_ for _ in ()).throw(
+                            ValueError("source died")
+                        )
+                        for x in [10, 11, 12]
+                    ),
+                    [PipeStage("body", record_ordinal)],
+                    ordinal_base=10,
+                ):
+                    pass
+        assert ei.value.tfs_chunk_index == 12
+
+    def test_checkpoint_metrics_and_diagnostics_surface(self, tmp_path):
+        allx = _write_int_shards(tmp_path, shards=2)
+        ck = tmp_path / "ck"
+        tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck), checkpoint_every=1,
+        )
+        tfs.reduce_blocks_stream(
+            _sum_fetch(), tfs.stream_dataset(str(tmp_path)),
+            checkpoint=str(ck),
+        )
+        flat = telemetry.flat_counters()
+        assert flat.get("checkpoint_commits", 0) >= 4
+        assert flat.get("checkpoint_resumes", 0) == 1
+        assert flat.get("checkpoint_chunks_skipped", 0) == 4
+        # the write-latency histogram observed every commit
+        hists = telemetry.metrics_snapshot()[2]
+        wh = [
+            (k, v) for k, v in hists.items()
+            if k[0] == "checkpoint_write_seconds"
+        ]
+        assert wh and wh[0][1][3] >= 4  # observation count
+        # checkpoint-kind spans were recorded
+        kinds = {s.kind for s in telemetry.spans()}
+        assert "checkpoint" in kinds
+        # Prometheus exposition carries HELP for the new family
+        prom = telemetry.export_prometheus()
+        assert "# HELP tfs_checkpoint_commits" in prom
+        # diagnostics: json section + text lines
+        data = tfs.diagnostics(format="json")
+        assert data["checkpoint"]["commits"] >= 4
+        assert data["checkpoint"]["last_commit"]["watermark"] == 4
+        txt = tfs.diagnostics()
+        assert "durable streams:" in txt
+        assert int(
+            np.asarray(
+                tfs.reduce_blocks_stream(
+                    _sum_fetch(), tfs.stream_dataset(str(tmp_path))
+                )
+            )
+        ) == int(allx.sum())
+
+    def test_env_seed_checkpoint_every(self):
+        # fresh interpreter: the env var seeds AND pins the knob
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "from tensorframes_tpu import config;"
+            "print(config.get().stream_checkpoint_every,"
+            " config.is_explicit('stream_checkpoint_every'))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(
+                os.environ, TFS_STREAM_CHECKPOINT_EVERY="7",
+                JAX_PLATFORMS="cpu",
+            ),
+            capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert out.stdout.strip().split()[-2:] == ["7", "True"]
+
+    def test_frame_from_ipc_bytes_empty_refused(self):
+        with pytest.raises(ValueError):
+            tio.frame_from_ipc_bytes(b"")

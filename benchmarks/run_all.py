@@ -86,6 +86,12 @@ SMOKE_SIZES = {
     "CKPT_GROUP_ROWS": "20000",
     "CKPT_ITERS": "2",
     "CKPT_EVERY": "2",
+    # globalframe smoke keeps the MANY-BLOCKS geometry (the dispatch-
+    # bound regime the one-SPMD-program claim is about) and trims rows
+    "GLOBAL_ROWS": "100000",
+    "GLOBAL_BLOCKS": "32",
+    "GLOBAL_ITERS": "3",
+    "GLOBAL_CHAIN": "8",
 }
 
 
@@ -115,9 +121,10 @@ def main():
         "overload_bench",
         "serving_bench",
         "autotune_bench",
-        # LAST THREE: on a 1-CPU-device host these retarget the process
+        # LAST FOUR: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them
+        "globalframe_bench",
         "scheduler_bench",
         "chaos_bench",
         "train_bench",

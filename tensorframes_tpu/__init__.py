@@ -48,6 +48,7 @@ from .api import (
     row,
 )
 from .lazy import explain_analyze
+from .globalframe import GlobalFrame
 from .graph import Graph, ShapeHints
 from .graph import builder as dsl
 from .runtime import Executor
@@ -97,6 +98,7 @@ __all__ = [
     "ScalarType",
     "Shape",
     "Unknown",
+    "GlobalFrame",
     "GroupedFrame",
     "LazyFrame",
     "lazy",

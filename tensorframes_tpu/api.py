@@ -800,6 +800,16 @@ def map_blocks(
             fetch_names=fetch_names, executor=executor, mesh=mesh,
             bindings=bindings, devices=devices,
         )
+    from . import globalframe as _gf
+
+    if isinstance(frame, _gf.GlobalFrame):
+        # sharded-array frame: ONE SPMD dispatch over its data mesh
+        # (mesh=/devices= rejected there — the frame owns placement)
+        return _gf.map_blocks_global(
+            fetches, frame, feed_dict=feed_dict, trim=trim,
+            fetch_names=fetch_names, executor=executor, mesh=mesh,
+            bindings=bindings, devices=devices,
+        )
     if (
         lazy_active()
         and isinstance(frame, TensorFrame)
@@ -874,6 +884,14 @@ def map_blocks(
         )
     ex = executor or default_executor()
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
+    if not trim and not bindings:
+        # block_scheduler="global": eligible row-local graphs dispatch
+        # as ONE sharded SPMD program instead of one program per block
+        routed = _gf.maybe_map_blocks(
+            graph, fetch_list, frame, feed_dict, ex, devices
+        )
+        if routed is not _gf.SKIP:
+            return routed
     overrides = _ph_overrides(
         graph, frame, feed_dict, block_level=True, bindings=bindings
     )
@@ -1080,6 +1098,15 @@ def map_rows(
         # terminal in effect: force the fused plan (one program per
         # block), then run the per-row verb on the concrete result
         frame = frame.force()
+    from . import globalframe as _gf
+
+    if isinstance(frame, _gf.GlobalFrame):
+        # one vmapped SPMD dispatch over the frame's data mesh
+        return _gf.map_rows_global(
+            fetches, frame, feed_dict=feed_dict, fetch_names=fetch_names,
+            executor=executor, mesh=mesh, bindings=bindings,
+            devices=devices,
+        )
     ex = executor or default_executor()
     bindings = {k: np.asarray(v) for k, v in (bindings or {}).items()}
     if callable(fetches) and not isinstance(fetches, dsl.Tensor):
@@ -1147,6 +1174,15 @@ def map_rows(
             "row; use map_blocks (or run the graph once and broadcast)"
         )
 
+    if dense and not bindings:
+        # block_scheduler="global": one vmapped SPMD dispatch instead
+        # of one per block
+        routed = _gf.maybe_map_rows(
+            graph, fetch_list, frame, feed_dict, ex, devices,
+            pre=(summary, mapping),
+        )
+        if routed is not _gf.SKIP:
+            return routed
     if dense:
         in_axes = tuple(None if p in bindings else 0 for p in params)
         bind_sig = ",".join(sorted(bindings))
@@ -1363,6 +1399,15 @@ def reduce_blocks(
         return frame.reduce_blocks(
             fetches, feed_dict, fetch_names, executor, mesh, devices=devices
         )
+    from . import globalframe as _gf
+
+    if isinstance(frame, _gf.GlobalFrame):
+        # ONE masked SPMD dispatch; classified reductions lower to
+        # in-program collectives over the frame's data mesh
+        return _gf.reduce_blocks_global(
+            fetches, frame, feed_dict=feed_dict, fetch_names=fetch_names,
+            executor=executor, mesh=mesh, devices=devices,
+        )
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
@@ -1371,6 +1416,13 @@ def reduce_blocks(
         )
     ex = executor or default_executor()
     graph, fetch_list = _as_graph(fetches, fetch_names)
+    # block_scheduler="global": classified monoid reduces dispatch as
+    # one sharded program with in-program collectives
+    routed = _gf.maybe_reduce_blocks(
+        graph, fetch_list, frame, feed_dict, ex, devices
+    )
+    if routed is not _gf.SKIP:
+        return routed
     overrides = _ph_overrides(graph, frame, feed_dict, block_level=True)
     summary = analyze_graph(graph, fetch_list, placeholder_shapes=overrides)
     _validate_reduce_blocks(summary, fetch_list)
@@ -1558,6 +1610,14 @@ def reduce_rows(
 
     if isinstance(frame, LazyFrame):
         frame = frame.force()
+    from . import globalframe as _gf
+
+    if isinstance(frame, _gf.GlobalFrame):
+        # a left fold in row order is inherently sequential: cross the
+        # local boundary (one block) and fold there — but the frame
+        # still owns its placement, so per-call overrides stay loud
+        _gf._reject_overrides("reduce_rows", mesh, devices)
+        frame = frame.to_frame()
     if mesh is not None:
         from .parallel import verbs as _pverbs
 
@@ -1716,6 +1776,16 @@ class GroupedFrame:
             # fused chain lowers as one program per block here, then
             # the keyed plans see a concrete device-resident frame
             frame = frame.force()
+        from . import globalframe as _gf
+
+        self._from_global = isinstance(frame, _gf.GlobalFrame)
+        if self._from_global:
+            # keyed aggregation factorizes keys on the host: cross the
+            # local boundary; the segment-plan aggregate then still
+            # runs one transform dispatch over the single block. The
+            # flag keeps `aggregate`'s placement-override rejection
+            # loud even though the frame is local from here on.
+            frame = frame.to_frame()
         self.frame = frame
         self.keys = list(keys)
         for k in self.keys:
@@ -1766,6 +1836,10 @@ def aggregate(
     and vmapped — one XLA call per distinct group size, each batched over
     all groups of that size.
     """
+    if getattr(grouped, "_from_global", False):
+        from . import globalframe as _gf
+
+        _gf._reject_overrides("aggregate", mesh, devices)
     if mesh is not None:
         from .parallel import verbs as _pverbs
 

@@ -207,6 +207,14 @@ class Config:
     #            per-device ledgers)
     #   "off"  — every dispatch lands on the default device (the
     #            pre-scheduler behavior)
+    #   "global" — route eligible verbs through `GlobalFrame` SPMD
+    #            dispatch (`globalframe.py`): columns become single
+    #            jax.Arrays sharded over a data mesh, one compiled
+    #            program spans every device, classified reduces lower
+    #            to in-program collectives. Ineligible dispatches
+    #            (non-row-local maps, unclassified reduces, ragged/
+    #            string feeds, frames below global_frame_min_rows)
+    #            fall back to per-block scheduling exactly as "auto".
     # mesh= always takes precedence, and the native executor is never
     # scheduled (it owns its own PJRT host). Per-call override: the
     # devices= parameter on every non-mesh verb. Under scheduling, jit
@@ -222,6 +230,20 @@ class Config:
             mapping={
                 "0": "off", "false": "off", "1": "on", "true": "on",
             },
+        )
+    )
+    # Global sharded frames (`globalframe.py`): a frame routed through
+    # the GlobalFrame SPMD path (block_scheduler="global", or the
+    # auto-route on eligible verbs) must carry at least this many rows;
+    # below it the per-shard work would be dominated by sharded
+    # device_put + collective latency and the verb falls back to
+    # per-block scheduling. Tunable by the closed-loop autotuner (an
+    # explicit update()/override()/env set pins it like every knob).
+    # Env override TFS_GLOBAL_FRAME_MIN_ROWS seeds the initial value.
+    global_frame_min_rows: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_GLOBAL_FRAME_MIN_ROWS", 2048, "global_frame_min_rows",
+            minimum=0,
         )
     )
     # Pipelined ingest (`ingest.pipeline`): stream verbs and the io

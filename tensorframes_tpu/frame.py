@@ -534,6 +534,18 @@ class TensorFrame:
             _tele.histogram_observe("h2d_bytes", float(h2d_bytes))
         return TensorFrame(new_cols, self.offsets)
 
+    def to_global(self, mesh=None) -> "GlobalFrame":  # noqa: F821
+        """Shard this frame's dense columns into single `jax.Array`s
+        over a data mesh (`globalframe.GlobalFrame`): every verb on the
+        result compiles to ONE SPMD program spanning all devices —
+        maps run shard-local, classified reduces lower to in-program
+        collectives. ``mesh`` defaults to a 1-D data mesh over every
+        healthy local device. `GlobalFrame.collect()` is the inverse
+        boundary (slices the sharded pad rows back off)."""
+        from .globalframe import GlobalFrame
+
+        return GlobalFrame.from_frame(self, mesh=mesh)
+
     # ---- lazy plans ----------------------------------------------------
     def lazy(self) -> "LazyFrame":  # noqa: F821 — forward ref, see lazy.py
         """Wrap this frame into a `LazyFrame`: subsequent graph-based

@@ -1,0 +1,993 @@
+"""GlobalFrame: one sharded-array frame, one SPMD dispatch per verb.
+
+The block scheduler (`runtime/scheduler.py`) and ``mesh=``
+(`parallel/verbs.py`) were two disjoint multi-device stories: the
+scheduler commits one dispatch PER BLOCK onto a chosen device —
+O(blocks) Python round-trips per verb — while ``mesh=`` shard_maps a
+separate code path most verbs, streaming and serving never take. The
+"TensorFlow Doing HPC" observation (PAPERS.md) is that expressing
+distribution as ONE compiled program over a device mesh, with
+reductions as in-program collectives, is what makes throughput
+hardware-bound rather than dispatch-bound. This module is that model:
+
+- A `GlobalFrame`'s dense columns are single `jax.Array`s sharded over
+  a 1-D data `Mesh` with ``PartitionSpec(("data",))`` on the lead dim
+  (`parallel.mesh` / SNIPPETS.md batch-dim sharding). The lead dim is
+  padded (last-row replication, `shape_policy.pad_lead`) up to
+  ``data_size x rung`` where ``rung`` buckets the PER-SHARD row count
+  on the ordinary ladder — so a drifting global row count compiles
+  O(log max-shard-rows) programs, the same warm-compile story as
+  per-block bucketing. The true row count (``nrows``) rides alongside;
+  `collect`/`to_frame` slice the pad rows back off.
+
+- ``map_blocks``/``map_rows`` on it compile to ONE jit program whose
+  committed input shardings make XLA (GSPMD) partition the work:
+  row-local graphs run shard-local with ZERO cross-device traffic and
+  outputs stay sharded, so chained maps never leave the mesh.
+
+- Classified reduces (`aggregate._chunk_combiners` monoids over
+  row-local transforms) lower through the SAME masked-reduce recipe as
+  the bucket ladder (`shape_policy.build_masked_reduce`): pad rows
+  mask to the reduction identity, the lead-axis reduction partitions
+  into per-shard reduces plus ONE in-program all-reduce
+  (psum/min/max) over ICI — no host-side partial gather+combine.
+  min/max and integer sums are bit-identical to the block-scheduler
+  path (any grouping of an idempotent/exact monoid agrees); float
+  sum/mean carry the repo's documented reassociation tolerance.
+
+- Everything the SPMD model cannot express exactly (non-row-local
+  maps, unclassified reduces, fn-front-end fetches, bindings,
+  ``trim``) FALLS BACK to the eager verb over `to_frame()` — counted
+  in the fallback ledger so diagnostics can say why a workload is not
+  on the fast path. ``reduce_rows`` (a left fold in row order) and
+  keyed ``aggregate`` (host key factorization) always take the local
+  path by contract.
+
+Routing: ``config.block_scheduler = "global"`` (env
+``TFS_BLOCK_SCHEDULER=global``) auto-routes eligible graph verbs on
+plain `TensorFrame`s through this path when the frame carries at least
+``config.global_frame_min_rows`` rows; below that — or for any
+ineligible dispatch — the verb falls back to ordinary per-block
+scheduling. An explicit `GlobalFrame` (via `TensorFrame.to_global`)
+always dispatches here; ``devices=``/``mesh=`` on its verbs are
+rejected loudly (the frame owns its mesh — one placement story, not
+three). Circuit-open devices shrink the mesh loudly
+(`scheduler.global_device_set`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .frame import Column, TensorFrame
+from .graph.analysis import analyze_graph
+from .graph.ir import base_name as _base
+from .ops.lowering import build_callable
+from .schema import FrameInfo, ScalarType
+
+# late-bound: api imports this module inside verb bodies only, so by
+# the time any function here runs, api is fully initialized (same
+# pattern as streaming.py)
+from . import api as _api
+from . import config as _config
+from . import shape_policy as _sp
+
+__all__ = ["GlobalFrame", "resolve_global_mesh", "state", "reset_state"]
+
+
+# ---------------------------------------------------------------------------
+# global-frame accounting (the diagnostics section + always-live counters)
+# ---------------------------------------------------------------------------
+
+_state_lock = threading.Lock()
+_stats: Dict = {
+    "frames": 0,            # GlobalFrames built (to_global / auto-route)
+    "dispatches": 0,        # single-program SPMD dispatches issued
+    "collectives": 0,       # in-program all-reduces lowered (1/reduce fetch)
+    "pad_rows": 0,          # synthetic rows on sharded lead dims
+    "fallbacks": {},        # reason -> count (why a dispatch left the path)
+    "last_shards": None,    # data-axis size of the most recent mesh
+}
+
+
+def _note_frame(shards: int, pad_rows: int) -> None:
+    from .utils import telemetry as _tele
+
+    with _state_lock:
+        _stats["frames"] += 1
+        _stats["pad_rows"] += int(pad_rows)
+        _stats["last_shards"] = int(shards)
+    if pad_rows:
+        _tele.counter_inc("global_pad_rows", float(pad_rows))
+
+
+def _note_dispatch(verb: str, collectives: int = 0) -> None:
+    from .utils import telemetry as _tele
+
+    with _state_lock:
+        _stats["dispatches"] += 1
+        _stats["collectives"] += int(collectives)
+    _tele.counter_inc("global_dispatches", 1.0, verb=verb)
+    if collectives:
+        _tele.counter_inc("global_collectives", float(collectives))
+
+
+def _note_fallback(reason: str) -> None:
+    from .utils import telemetry as _tele
+
+    with _state_lock:
+        _stats["fallbacks"][reason] = _stats["fallbacks"].get(reason, 0) + 1
+    _tele.counter_inc("global_fallbacks", 1.0, reason=reason)
+
+
+_route_tls = threading.local()
+
+
+@contextlib.contextmanager
+def _suppress_route():
+    """An explicit-GlobalFrame fallback re-enters the verb layer over
+    `to_frame()`; under ``block_scheduler="global"`` the auto-route
+    must not probe (and count a second fallback for) the very dispatch
+    that IS the fallback."""
+    prev = getattr(_route_tls, "suppressed", False)
+    _route_tls.suppressed = True
+    try:
+        yield
+    finally:
+        _route_tls.suppressed = prev
+
+
+def state() -> Dict:
+    """Snapshot for `tfs.diagnostics()`: shard count, dispatch and
+    collective counts, pad waste on the sharded lead dim, fallback
+    reasons."""
+    with _state_lock:
+        return {
+            "frames": _stats["frames"],
+            "dispatches": _stats["dispatches"],
+            "collectives": _stats["collectives"],
+            "pad_rows": _stats["pad_rows"],
+            "fallbacks": dict(_stats["fallbacks"]),
+            "shards": _stats["last_shards"],
+        }
+
+
+def reset_state() -> None:
+    with _state_lock:
+        _stats.update(
+            frames=0, dispatches=0, collectives=0, pad_rows=0,
+            fallbacks={}, last_shards=None,
+        )
+
+
+# ---------------------------------------------------------------------------
+# mesh resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_global_mesh():
+    """The data mesh a new `GlobalFrame` shards over: a 1-D ``data``
+    mesh spanning every HEALTHY local device
+    (`scheduler.global_device_set` — circuit-open devices shrink it
+    loudly). Memoized on the device-label tuple so repeated verbs reuse
+    one `Mesh` object (jit's sharding cache keys on mesh equality).
+
+    The mesh is built directly from `jax.sharding` rather than through
+    `parallel.data_mesh`: the `parallel` package __init__ pulls in
+    shard_map-dependent modules this path never needs."""
+    from jax.sharding import Mesh
+    from .runtime import scheduler as _rs
+
+    devs = _rs.global_device_set()
+    if not devs:
+        return None
+    key = tuple(_rs.device_label(d) for d in devs)
+    with _state_lock:
+        cached = _stats.get("_mesh_cache")
+        if cached is not None and cached[0] == key:
+            return cached[1]
+    mesh = Mesh(np.asarray(devs), ("data",))
+    with _state_lock:
+        _stats["_mesh_cache"] = (key, mesh)
+    return mesh
+
+
+def _padded_rows_for(nrows: int, ndata: int) -> int:
+    """Sharded lead dim for ``nrows`` over ``ndata`` shards: the bucket
+    ladder applies to the PER-SHARD row count (`bucket_for`), so the
+    warm-compile story of per-block bucketing carries over — a
+    drifting global row count hits O(log max-shard-rows) compiled
+    shapes. With bucketing off, pad only to divisibility."""
+    per_shard = -(-nrows // ndata)
+    if _sp.enabled():
+        per_shard = _sp.bucket_for(per_shard)
+    return per_shard * ndata
+
+
+# ---------------------------------------------------------------------------
+# the frame
+# ---------------------------------------------------------------------------
+
+
+class GlobalFrame:
+    """A frame whose dense columns are single sharded `jax.Array`s.
+
+    Logically ONE block spanning the whole mesh (``num_blocks == 1``);
+    the padded lead dim (``padded_rows = data_size x shard_rows``) is
+    an execution detail — `nrows` is the truth, and every host-visible
+    export slices back to it. Construct via `TensorFrame.to_global()`
+    or `GlobalFrame.from_frame`; verbs dispatch through `api` exactly
+    like TensorFrames (fluent methods installed below)."""
+
+    def __init__(self, columns: Sequence[Column], mesh, nrows: int):
+        if not columns:
+            raise ValueError("a GlobalFrame needs at least one column")
+        self._cols: Dict[str, Column] = {}
+        padded = None
+        for c in columns:
+            if padded is None:
+                padded = len(c)
+            elif len(c) != padded:
+                raise ValueError(
+                    f"column {c.name!r} has {len(c)} padded rows, "
+                    f"expected {padded}"
+                )
+            if c.name in self._cols:
+                raise ValueError(f"duplicate column {c.name!r}")
+            self._cols[c.name] = c
+        self.mesh = mesh
+        self.nrows = int(nrows)
+        self.padded_rows = int(padded)
+        self._local: Optional[TensorFrame] = None
+        self.data_size = int(mesh.shape["data"])
+        if self.padded_rows % self.data_size:
+            raise ValueError(
+                f"padded lead dim {self.padded_rows} is not divisible by "
+                f"the data-axis size {self.data_size}"
+            )
+        self.shard_rows = self.padded_rows // self.data_size
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_frame(
+        cls, frame: TensorFrame, mesh=None, columns: Optional[Sequence[str]] = None
+    ) -> "GlobalFrame":
+        """Shard ``frame``'s dense columns over the mesh's ``data``
+        axis. Ragged and string columns cannot go to device and are
+        rejected loudly (select the dense columns first, or stay on the
+        per-block path). ``columns`` restricts the conversion (the
+        auto-route converts only the columns a graph actually feeds)."""
+        if isinstance(frame, GlobalFrame):
+            return frame
+        if frame.nrows == 0:
+            raise ValueError("to_global on an empty frame")
+        if mesh is None:
+            mesh = resolve_global_mesh()
+        if mesh is None or "data" not in mesh.shape:
+            raise ValueError(
+                "to_global needs a mesh with a 'data' axis (none could "
+                "be resolved from the local devices)"
+            )
+        names = list(columns) if columns is not None else frame.columns
+        for n in names:
+            c = frame.column(n)
+            if not c.is_dense or c.dtype is ScalarType.string:
+                raise ValueError(
+                    f"to_global: column {n!r} is "
+                    f"{'ragged' if not c.is_dense else 'a bytes column'}; "
+                    "global frames hold dense device-shardable columns "
+                    "only — select() the dense columns or use the "
+                    "per-block path"
+                )
+        ndata = int(mesh.shape["data"])
+        padded = _padded_rows_for(frame.nrows, ndata)
+        from .utils import telemetry as _tele
+
+        h2d_bytes = 0
+        new_cols: List[Column] = []
+        # transfer span: the sharded device_put issue window (async —
+        # per-shard H2D copies to different devices overlap)
+        with _tele.span(
+            "to_global", kind="transfer", sharding=f"data:{ndata}"
+        ):
+            for n in names:
+                c = frame.column(n)
+                vals = _sp.pad_lead(c.values, frame.nrows, padded)
+                if isinstance(vals, np.ndarray):
+                    h2d_bytes += vals.nbytes
+                spec = P("data", *([None] * (vals.ndim - 1)))
+                arr = jax.device_put(vals, NamedSharding(mesh, spec))
+                nc = Column(n, arr, c.dtype)
+                nc.cell_shape = c.cell_shape
+                new_cols.append(nc)
+        if h2d_bytes and _tele.enabled():
+            _tele.histogram_observe("h2d_bytes", float(h2d_bytes))
+        _sp.observe_fill(frame.nrows, padded, verb="to_global")
+        _note_frame(ndata, padded - frame.nrows)
+        return cls(new_cols, mesh, frame.nrows)
+
+    # -- frame-shaped surface -------------------------------------------
+    @property
+    def columns(self) -> List[str]:
+        return list(self._cols.keys())
+
+    @property
+    def info(self) -> FrameInfo:
+        return FrameInfo([c.info for c in self._cols.values()])
+
+    def column(self, name: str) -> Column:
+        if name not in self._cols:
+            raise KeyError(
+                f"no column {name!r}; available: {self.columns}"
+            )
+        return self._cols[name]
+
+    def __getitem__(self, name: str) -> Column:
+        return self.column(name)
+
+    @property
+    def num_blocks(self) -> int:
+        return 1
+
+    @property
+    def offsets(self) -> List[int]:
+        return [0, self.nrows]
+
+    def block_sizes(self) -> List[int]:
+        return [self.nrows]
+
+    @property
+    def pad_rows(self) -> int:
+        return self.padded_rows - self.nrows
+
+    def __repr__(self) -> str:
+        return (
+            f"GlobalFrame[{self.nrows} rows x {len(self._cols)} cols, "
+            f"data:{self.data_size} sharded, {self.shard_rows} rows/shard"
+            f"{f', +{self.pad_rows} pad' if self.pad_rows else ''}]"
+        )
+
+    # -- boundaries -----------------------------------------------------
+    def to_frame(self) -> TensorFrame:
+        """The sharded -> local boundary: one single-block `TensorFrame`
+        whose columns are the valid-row slices of the sharded arrays
+        (lazy device slices — nothing is host-fetched here). The
+        fallback target of every dispatch the SPMD model cannot
+        express. Memoized: the frame is immutable, and a fresh Column
+        per call would discard the Column-level host cache and re-pay
+        the D2H transfer on every collect()/to_pandas()."""
+        if self._local is None:
+            cols = []
+            for c in self._cols.values():
+                vals = (
+                    c.values[: self.nrows]
+                    if self.padded_rows != self.nrows
+                    else c.values
+                )
+                nc = Column(c.name, vals, c.dtype)
+                nc.cell_shape = c.cell_shape
+                cols.append(nc)
+            self._local = TensorFrame(cols, [0, self.nrows])
+        return self._local
+
+    def collect(self):
+        return self.to_frame().collect()
+
+    def to_pandas(self):
+        return self.to_frame().to_pandas()
+
+    def host_values(self, name: str) -> np.ndarray:
+        return self.to_frame().host_values(name)
+
+    def select(self, names: Sequence[str]) -> "GlobalFrame":
+        return GlobalFrame(
+            [self.column(n) for n in names], self.mesh, self.nrows
+        )
+
+    def lazy(self):
+        """Wrap into a `LazyFrame` over this global base: deferred map
+        chains force as ONE fused SPMD dispatch, and a fused reduce
+        terminal lowers its collectives in-program (see `lazy.py`)."""
+        from .lazy import LazyFrame
+
+        return LazyFrame(self)
+
+    def print_schema(self) -> None:
+        print(self.info.explain())
+
+
+# ---------------------------------------------------------------------------
+# the one-dispatch core
+# ---------------------------------------------------------------------------
+
+
+def _reject_overrides(verb: str, mesh, devices) -> None:
+    """A GlobalFrame owns its mesh: per-call placement overrides are
+    rejected loudly rather than silently ignored (three placement
+    stories collapsing into one is the point)."""
+    if mesh is not None:
+        raise ValueError(
+            f"{verb}: mesh= is not accepted on a GlobalFrame — the "
+            "frame is already sharded over its own mesh; collect() "
+            "first to re-place"
+        )
+    if devices is not None:
+        raise ValueError(
+            f"{verb}: devices= is not accepted on a GlobalFrame — the "
+            "global SPMD path owns placement (the frame's data mesh); "
+            "collect() first, or drop the devices= pin"
+        )
+
+
+def _dispatch_one(
+    span_name: str, verb: str, fn, valid: Optional[int], gf: GlobalFrame,
+    feeds: Sequence, fp: str, collectives: int = 0,
+):
+    """THE single SPMD dispatch: one compiled program over the whole
+    mesh, under the verb's deadline (cooperative check at the
+    boundary), classified fault handling (transient retries — there is
+    no per-block schedule to fail over, and no row range to split: a
+    resource error records its forensic snapshot and re-raises), and a
+    dispatch span labeled ``sharding=data:N`` plus the padded lead as
+    its ``bucket`` (pad-waste accounting rides the usual span join)."""
+    from .runtime import deadline as _dl
+    from .runtime import faults as _flt
+    from .utils import telemetry as _tele
+
+    _dl.check(verb)
+    fscope = _flt.scope(verb)
+
+    def _thunk():
+        with _tele.dispatch_span(
+            span_name, program=fp, rows=gf.nrows, bucket=gf.padded_rows,
+            sharding=f"data:{gf.data_size}",
+            masked=(valid is not None) or None,
+        ):
+            if valid is None:
+                return fn(*feeds)
+            return fn(np.int32(valid), *feeds)
+
+    try:
+        outs = fscope.dispatch(
+            _thunk, what=f"{verb} global frame rows [0:{gf.nrows})"
+        )
+    except Exception as e:
+        if _flt.classify(e) == _flt.RESOURCE:
+            _flt.record_oom(
+                verb, fp, gf.nrows, 0, "reraise:global-frame", e,
+                bucket=gf.padded_rows,
+            )
+        raise
+    _note_dispatch(verb, collectives=collectives)
+    return tuple(outs)
+
+
+def _analyze(graph, fetch_list, gf, feed_dict, block_level: bool):
+    overrides = _api._ph_overrides(
+        graph, gf, feed_dict, block_level=block_level
+    )
+    summary = analyze_graph(
+        graph, fetch_list, placeholder_shapes=overrides
+    )
+    mapping = _api._match_columns(
+        summary, gf, feed_dict, block_level=block_level
+    )
+    return summary, mapping
+
+
+def _spmd_capable(ex) -> bool:
+    """The SPMD path device_puts sharded in-process jax arrays, so it
+    needs the same opt-in as the block scheduler (the native executor
+    owns its own PJRT host and must never see them)."""
+    return getattr(ex, "supports_scheduling", False)
+
+
+def _map_dispatch(graph, fetch_list, gf: GlobalFrame, mapping, ex,
+                  vmap: bool):
+    """One shard-local map dispatch — the recipe shared by the
+    explicit-GlobalFrame verbs and the "global"-mode auto-route (they
+    differ only in output assembly): cached program build, the single
+    SPMD dispatch, numerics check, and the lead-dim preservation check
+    the row-local gate promised."""
+    from .runtime.faults import maybe_check_numerics
+
+    verb = "map_rows" if vmap else "map_blocks"
+    feed_names = sorted(mapping)
+    if vmap:
+        build = lambda: jax.jit(  # noqa: E731
+            jax.vmap(build_callable(graph, fetch_list, feed_names))
+        )
+    else:
+        build = lambda: jax.jit(  # noqa: E731
+            build_callable(graph, fetch_list, feed_names)
+        )
+    fn = ex.cached(
+        "global-vmap-rows" if vmap else "global-map",
+        graph, fetch_list, feed_names, build,
+    )
+    feeds = [gf.column(mapping[n]).values for n in feed_names]
+    outs = _dispatch_one(
+        f"{verb}.global", verb, fn, None, gf, feeds, graph.fingerprint()
+    )
+    maybe_check_numerics(fetch_list, outs, f"{verb} (global)")
+    for f, o in zip(fetch_list, outs):
+        if getattr(o, "ndim", 0) == 0 or o.shape[0] != gf.padded_rows:
+            raise ValueError(
+                f"{verb}: output {_base(f)!r} does not preserve the "
+                "sharded lead dim; row-count-changing graphs cannot "
+                "run on the global SPMD path (the per-block path with "
+                "trim=True handles row-count-changing maps)"
+            )
+    return outs
+
+
+def _reduce_dispatch(graph, fetch_list, gf: GlobalFrame, mapping, plan,
+                     ex):
+    """One masked SPMD reduce dispatch (per-shard reduces + in-program
+    collectives) — shared by `reduce_blocks_global` and the
+    auto-route."""
+    from .runtime.faults import maybe_check_numerics
+
+    feed_names = sorted(mapping)
+    fn = ex.cached(
+        "global-reduce", graph, fetch_list, feed_names,
+        lambda: jax.jit(_sp.build_masked_reduce(graph, plan, feed_names)),
+    )
+    feeds = [gf.column(mapping[n]).values for n in feed_names]
+    outs = _dispatch_one(
+        "reduce_blocks.global", "reduce_blocks", fn, gf.nrows, gf, feeds,
+        graph.fingerprint(), collectives=len(fetch_list),
+    )
+    maybe_check_numerics(fetch_list, outs, "reduce_blocks (global)")
+    if len(fetch_list) == 1:
+        return outs[0]
+    return {_base(f): v for f, v in zip(fetch_list, outs)}
+
+
+def _output_global(
+    gf: GlobalFrame, fetch_list: Sequence[str], outs: Sequence
+) -> GlobalFrame:
+    """Assemble a map verb's output GlobalFrame: graph outputs first,
+    sorted by name, then passthrough input columns — the same ordering
+    as the eager `_output_frame` (lead dims already validated by
+    `_map_dispatch`)."""
+    out_cols = [Column(_base(f), o) for f, o in zip(fetch_list, outs)]
+    out_cols.sort(key=lambda c: c.name)
+    shadow = {c.name for c in out_cols}
+    cols = out_cols + [
+        gf.column(n) for n in gf.columns if n not in shadow
+    ]
+    return GlobalFrame(cols, gf.mesh, gf.nrows)
+
+
+def _fallback_map(fetches, gf, feed_dict, trim, fetch_names, executor,
+                  bindings, reason: str) -> GlobalFrame:
+    """Run the eager verb over the local boundary and re-globalize the
+    result onto the SAME mesh, so explicit-GlobalFrame chains keep
+    their type across an ineligible stage. Counted: diagnostics must
+    be able to say why a workload left the fast path."""
+    _note_fallback(reason)
+    with _suppress_route():
+        out = _api.map_blocks(
+            fetches, gf.to_frame(), feed_dict, trim, fetch_names, executor,
+            bindings=bindings,
+        )
+    return GlobalFrame.from_frame(out, mesh=gf.mesh)
+
+
+# ---------------------------------------------------------------------------
+# verbs on an explicit GlobalFrame
+# ---------------------------------------------------------------------------
+
+
+def map_blocks_global(
+    fetches, gf: GlobalFrame, feed_dict=None, trim=False, fetch_names=None,
+    executor=None, mesh=None, bindings=None, devices=None,
+) -> GlobalFrame:
+    _reject_overrides("map_blocks", mesh, devices)
+    if trim:
+        raise ValueError(
+            "map_blocks(trim=True) is not supported on a GlobalFrame: "
+            "trimmed maps change the row count under the sharded lead "
+            "dim; collect() first"
+        )
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
+        return _fallback_map(
+            fetches, gf, feed_dict, trim, fetch_names, executor, bindings,
+            "fn-frontend",
+        )
+    graph, fetch_list = _api._as_graph(fetches, fetch_names)
+    if bindings:
+        return _fallback_map(
+            graph, gf, feed_dict, trim, fetch_list, executor, bindings,
+            "bindings",
+        )
+    if any(
+        ph.dtype_attr is ScalarType.string for ph in graph.placeholders()
+    ):
+        return _fallback_map(
+            graph, gf, feed_dict, trim, fetch_list, executor, None,
+            "bytes-passthrough",
+        )
+    if not _spmd_capable(ex):
+        return _fallback_map(
+            graph, gf, feed_dict, trim, fetch_list, executor, None,
+            "executor",
+        )
+    summary, mapping = _analyze(graph, fetch_list, gf, feed_dict, True)
+    if not _sp.rowwise_fetches(
+        graph, fetch_list,
+        {p: ph.shape.rank for p, ph in summary.inputs.items()},
+    ):
+        # a non-row-local map over a sharded lead dim would see the pad
+        # rows (and XLA would insert collectives mid-map); it runs on
+        # the exact local boundary instead
+        return _fallback_map(
+            graph, gf, feed_dict, trim, fetch_list, executor, None,
+            "not-row-local",
+        )
+    outs = _map_dispatch(graph, fetch_list, gf, mapping, ex, vmap=False)
+    return _output_global(gf, fetch_list, outs)
+
+
+def map_rows_global(
+    fetches, gf: GlobalFrame, feed_dict=None, fetch_names=None,
+    executor=None, mesh=None, bindings=None, devices=None,
+) -> GlobalFrame:
+    _reject_overrides("map_rows", mesh, devices)
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+
+    def fallback(fs, names, reason):
+        _note_fallback(reason)
+        with _suppress_route():
+            out = _api.map_rows(
+                fs, gf.to_frame(), feed_dict, names, executor,
+                bindings=bindings,
+            )
+        return GlobalFrame.from_frame(out, mesh=gf.mesh)
+
+    if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
+        return fallback(fetches, fetch_names, "fn-frontend")
+    graph, fetch_list = _api._as_graph(fetches, fetch_names)
+    if bindings:
+        return fallback(graph, fetch_list, "bindings")
+    if any(
+        ph.dtype_attr is ScalarType.string for ph in graph.placeholders()
+    ):
+        return fallback(graph, fetch_list, "bytes-passthrough")
+    if not _spmd_capable(ex):
+        return fallback(graph, fetch_list, "executor")
+    summary, mapping = _analyze(graph, fetch_list, gf, feed_dict, False)
+    # the vmapped per-row program is row-local BY CONSTRUCTION: one
+    # batched program over the sharded lead dim, zero communication
+    outs = _map_dispatch(graph, fetch_list, gf, mapping, ex, vmap=True)
+    return _output_global(gf, fetch_list, outs)
+
+
+def stream_reduce_eligible(graph, fetch_list, gf, feed_dict,
+                           executor=None) -> bool:
+    """True when `reduce_blocks` on this GlobalFrame lowers to the
+    one-dispatch masked-collective program. The ingest stream checks
+    ONCE, on its first sharded chunk, and stops sharding when the
+    answer is no — an unclassifiable reduce graph is fixed for the
+    stream's lifetime, so paying a sharded H2D plus a local-boundary
+    fallback re-gather on EVERY chunk would be pure waste."""
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if not _spmd_capable(ex):
+        return False
+    try:
+        summary, _ = _analyze(graph, fetch_list, gf, feed_dict, True)
+        return (
+            _sp.masked_reduce_plan(graph, fetch_list, summary) is not None
+        )
+    except Exception:
+        return False
+
+
+def reduce_blocks_global(
+    fetches, gf: GlobalFrame, feed_dict=None, fetch_names=None,
+    executor=None, mesh=None, devices=None,
+):
+    _reject_overrides("reduce_blocks", mesh, devices)
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    graph, fetch_list = _api._as_graph(fetches, fetch_names)
+    summary, mapping = _analyze(graph, fetch_list, gf, feed_dict, True)
+    _api._validate_reduce_blocks(summary, fetch_list)
+    plan = (
+        _sp.masked_reduce_plan(graph, fetch_list, summary)
+        if _spmd_capable(ex)
+        else None
+    )
+    if plan is None:
+        # unclassified reduce: no monoid structure to lower as an
+        # in-program collective — run the exact eager verb on the local
+        # boundary (still one dispatch: the frame is one block)
+        _note_fallback(
+            "unclassified-reduce" if _spmd_capable(ex) else "executor"
+        )
+        with _suppress_route():
+            return _api.reduce_blocks(
+                graph, gf.to_frame(), feed_dict, fetch_list, executor
+            )
+    return _reduce_dispatch(graph, fetch_list, gf, mapping, plan, ex)
+
+
+# ---------------------------------------------------------------------------
+# "global" scheduler-mode auto-routing (plain TensorFrame verbs)
+# ---------------------------------------------------------------------------
+
+# sentinel: the verb was NOT routed — the eager path must continue (a
+# routed reduce may legitimately return any value, including arrays)
+SKIP = object()
+
+
+def _route_eligible(frame, ex, devices) -> bool:
+    from .runtime import scheduler as _rs
+
+    if getattr(_route_tls, "suppressed", False):
+        return False
+    cfg = _config.get()
+    return (
+        devices is None
+        and _rs.global_mode()
+        and isinstance(frame, TensorFrame)
+        and frame.nrows >= max(1, cfg.global_frame_min_rows)
+        and _spmd_capable(ex)
+    )
+
+
+def _try_match(graph, fetch_list, frame, feed_dict, block_level):
+    """Analysis + matching for the auto-route, swallowing errors: a
+    mismatch must surface from the EAGER path (the canonical error
+    messages), not from the routing probe."""
+    try:
+        overrides = _api._ph_overrides(
+            graph, frame, feed_dict, block_level=block_level
+        )
+        summary = analyze_graph(
+            graph, fetch_list, placeholder_shapes=overrides
+        )
+        mapping = _api._match_columns(
+            summary, frame, feed_dict, block_level=block_level
+        )
+    except Exception:
+        return None, None
+    return _routable(summary, mapping, frame)
+
+
+def _routable(summary, mapping, frame):
+    """Column-level routing gate, shared with callers that hand in an
+    already-computed analysis (`maybe_map_rows(pre=)`)."""
+    used = sorted(set(mapping.values()))
+    if not used:
+        return None, None  # const-only graph: nothing to shard
+    for c in used:
+        col = frame.column(c)
+        if not col.is_dense or col.dtype is ScalarType.string:
+            return None, None
+    return summary, mapping
+
+
+def maybe_map_blocks(graph, fetch_list, frame, feed_dict, executor, devices):
+    """Auto-route an eager `map_blocks` (graph path, no trim/bindings/
+    mesh) through one SPMD dispatch under ``block_scheduler="global"``.
+    Returns a `TensorFrame` with the INPUT's offsets (blocks are index
+    ranges; the values are the valid-row slices of the sharded
+    outputs), or `SKIP` when ineligible — the eager per-block path then
+    runs exactly as under "auto"."""
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if not _route_eligible(frame, ex, devices):
+        return SKIP
+    summary, mapping = _try_match(graph, fetch_list, frame, feed_dict, True)
+    if summary is None:
+        return SKIP
+    if not _sp.rowwise_fetches(
+        graph, fetch_list,
+        {p: ph.shape.rank for p, ph in summary.inputs.items()},
+    ):
+        _note_fallback("not-row-local")
+        return SKIP
+    gf = GlobalFrame.from_frame(
+        frame, mesh=None, columns=sorted(set(mapping.values()))
+    )
+    outs = _map_dispatch(graph, fetch_list, gf, mapping, ex, vmap=False)
+    out_cols = [
+        Column(_base(f), o[: frame.nrows])
+        for f, o in zip(fetch_list, outs)
+    ]
+    return _api._output_frame(frame, out_cols, append_input=True)
+
+
+def maybe_map_rows(graph, fetch_list, frame, feed_dict, executor, devices,
+                   pre=None):
+    """`maybe_map_blocks`'s per-row sibling: one vmapped SPMD dispatch
+    instead of one per block. ``pre`` hands in the (summary, mapping)
+    the eager verb already computed — `map_rows` analyzes before it
+    probes, so the route must not pay that analysis twice."""
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if not _route_eligible(frame, ex, devices):
+        return SKIP
+    if pre is not None:
+        summary, mapping = _routable(pre[0], pre[1], frame)
+    else:
+        summary, mapping = _try_match(
+            graph, fetch_list, frame, feed_dict, False
+        )
+    if summary is None:
+        return SKIP
+    gf = GlobalFrame.from_frame(
+        frame, mesh=None, columns=sorted(set(mapping.values()))
+    )
+    outs = _map_dispatch(graph, fetch_list, gf, mapping, ex, vmap=True)
+    out_cols = [
+        Column(_base(f), o[: frame.nrows])
+        for f, o in zip(fetch_list, outs)
+    ]
+    return _api._output_frame(frame, out_cols, append_input=True)
+
+
+def maybe_reduce_blocks(graph, fetch_list, frame, feed_dict, executor,
+                        devices):
+    """Auto-route an eager `reduce_blocks` through one masked SPMD
+    dispatch with in-program collectives — classified monoid reduces
+    only (the bit-identity/tolerance contract is exactly the masked
+    bucketed program's). Returns the reduce result, or `SKIP`."""
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if not _route_eligible(frame, ex, devices):
+        return SKIP
+    summary, mapping = _try_match(graph, fetch_list, frame, feed_dict, True)
+    if summary is None:
+        return SKIP
+    try:
+        _api._validate_reduce_blocks(summary, fetch_list)
+    except Exception:
+        return SKIP  # the eager path owns the canonical error
+    plan = _sp.masked_reduce_plan(graph, fetch_list, summary)
+    if plan is None:
+        _note_fallback("unclassified-reduce")
+        return SKIP
+    gf = GlobalFrame.from_frame(
+        frame, mesh=None, columns=sorted(set(mapping.values()))
+    )
+    return _reduce_dispatch(graph, fetch_list, gf, mapping, plan, ex)
+
+
+# ---------------------------------------------------------------------------
+# fused lazy plans over a GlobalFrame base (lazy.py calls these)
+# ---------------------------------------------------------------------------
+
+
+def force_fused_global(
+    lf, gf: GlobalFrame, ex, fetch_edges: List[str], out_names: List[str],
+    feed_names: List[str],
+):
+    """Force a fused lazy map chain over a GlobalFrame base as ONE SPMD
+    dispatch. Returns the concrete `TensorFrame` (valid-row slices +
+    passthrough), or None when the fused chain is not row-local /
+    the executor cannot take sharded arrays — the caller then runs the
+    ordinary single-block loop on the duck-typed frame."""
+    from .runtime.faults import maybe_check_numerics
+    from .utils import telemetry as _tele
+
+    graph = lf._graph
+    feed_map = lf._feed_map
+    if not _spmd_capable(ex):
+        _note_fallback("executor")
+        return None
+    if not _sp.rowwise_fetches(
+        graph, fetch_edges,
+        {
+            ph: gf.info[col].block_shape.rank
+            for ph, col in feed_map.items()
+        },
+    ):
+        _note_fallback("lazy-not-row-local")
+        return None
+    fn = ex.cached(
+        "global-map", graph, fetch_edges, feed_names,
+        lambda: jax.jit(build_callable(graph, fetch_edges, feed_names)),
+    )
+    feeds = [gf.column(feed_map[n]).values for n in feed_names]
+    with _tele.span(
+        "lazy.force.blocks", kind="stage", program=graph.fingerprint()
+    ):
+        outs = _dispatch_one(
+            "lazy.force.global", "lazy.force", fn, None, gf, feeds,
+            graph.fingerprint(),
+        )
+    maybe_check_numerics(out_names, outs, "lazy fused (global)")
+    with _tele.span("lazy.force.collect", kind="stage"):
+        out_cols = []
+        for n, o in zip(out_names, outs):
+            if getattr(o, "ndim", 0) == 0 or o.shape[0] != gf.padded_rows:
+                raise ValueError(
+                    f"lazy plan output {n!r} does not preserve the "
+                    "sharded lead dim; trimmed/reducing stages cannot "
+                    "be part of a lazy map plan"
+                )
+            out_cols.append(Column(n, o[: gf.nrows]))
+        shadow = set(out_names)
+        base_local = gf.to_frame()
+        cols = out_cols + [
+            base_local.column(c) for c in gf.columns if c not in shadow
+        ]
+    return TensorFrame(cols, [0, gf.nrows])
+
+
+def fused_reduce_global(
+    fused, fused_fetches: List[str], feed_map: Dict[str, str],
+    feed_names: List[str], gf: GlobalFrame, fused_plan, ex,
+) -> Optional[Tuple]:
+    """One masked SPMD dispatch for a fused lazy map->reduce chain over
+    a GlobalFrame base: the whole pending chain plus the masked monoid
+    reduce compile into one program whose reductions lower to
+    in-program collectives. None (caller falls back to the ordinary
+    single-block loop) when the fused chain did not classify."""
+    if fused_plan is None or not _spmd_capable(ex):
+        _note_fallback(
+            "unclassified-reduce" if _spmd_capable(ex) else "executor"
+        )
+        return None
+    fn = ex.cached(
+        "global-reduce", fused, fused_fetches, feed_names,
+        lambda: jax.jit(
+            _sp.build_masked_reduce(fused, fused_plan, feed_names)
+        ),
+    )
+    feeds = [gf.column(feed_map[n]).values for n in feed_names]
+    return _dispatch_one(
+        "reduce_blocks.fused.global", "reduce_blocks.fused", fn, gf.nrows,
+        gf, feeds, fused.fingerprint(), collectives=len(fused_fetches),
+    )
+
+
+# ---------------------------------------------------------------------------
+# fluent methods (mirror TensorFrame's: gf.map_blocks(...) etc.)
+# ---------------------------------------------------------------------------
+
+
+def _install_fluent_methods() -> None:
+    def _map_blocks(self, fetches, **kw):
+        return _api.map_blocks(fetches, self, **kw)
+
+    def _map_rows(self, fetches, **kw):
+        return _api.map_rows(fetches, self, **kw)
+
+    def _reduce_blocks(self, fetches, **kw):
+        return _api.reduce_blocks(fetches, self, **kw)
+
+    def _reduce_rows(self, fetches, **kw):
+        return _api.reduce_rows(fetches, self, **kw)
+
+    def _group_by(self, *keys):
+        return _api.GroupedFrame(self, keys)
+
+    GlobalFrame.map_blocks = _map_blocks
+    GlobalFrame.map_rows = _map_rows
+    GlobalFrame.reduce_blocks = _reduce_blocks
+    GlobalFrame.reduce_rows = _reduce_rows
+    GlobalFrame.group_by = _group_by
+
+
+_install_fluent_methods()

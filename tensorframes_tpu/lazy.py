@@ -463,9 +463,13 @@ class LazyFrame:
         # graph to prove it)
         from . import config as _config
 
+        from . import globalframe as _gfm
+
         fused_plan = None
         if mesh is None and (
-            _sp.enabled(ex) or _config.get().oom_split_depth > 0
+            _sp.enabled(ex)
+            or _config.get().oom_split_depth > 0
+            or isinstance(frame, _gfm.GlobalFrame)
         ):
             classified = _chunk_combiners(rgraph, rfetch, rsummary)
             if classified is not None:
@@ -486,7 +490,25 @@ class LazyFrame:
         # records "reduce_blocks" around this call, and fused-vs-eager
         # dispatch is worth telling apart in stats anyway
         with record("reduce_blocks.fused", frame.nrows):
-            if mesh is not None:
+            gfinal = None
+            if mesh is None and isinstance(frame, _gfm.GlobalFrame):
+                # sharded base: fused chain + masked monoid reduce in
+                # ONE program, reductions as in-program collectives; a
+                # fallback (unclassified chain) crosses the local
+                # boundary and runs the single-block loop below
+                gfinal = _gfm.fused_reduce_global(
+                    fused, fused_fetches, feed_map, feed_names, frame,
+                    fused_plan, ex,
+                )
+                if gfinal is None:
+                    frame = frame.to_frame()
+                else:
+                    maybe_check_numerics(
+                        rfetch, gfinal, "reduce_blocks (fused, global)"
+                    )
+            if gfinal is not None:
+                final = gfinal
+            elif mesh is not None:
                 from .parallel import verbs as _pverbs
 
                 final = _pverbs.fused_reduce_blocks(
@@ -625,7 +647,24 @@ class LazyFrame:
             frame, [self._feed_map[n] for n in feed_names], "lazy.force"
         )
         with record("lazy.force", frame.nrows):
-            if use_mesh is not None and frame.nrows > 0:
+            gout = None
+            if use_mesh is None and frame.nrows > 0:
+                from . import globalframe as _gfm
+
+                if isinstance(frame, _gfm.GlobalFrame):
+                    # sharded base: the whole fused chain lowers as ONE
+                    # SPMD dispatch (row-local chains only); a fallback
+                    # crosses the local boundary and runs the ordinary
+                    # single-block loop below
+                    gout = _gfm.force_fused_global(
+                        self, frame, ex, fetch_edges, out_names,
+                        feed_names,
+                    )
+                    if gout is None:
+                        frame = frame.to_frame()
+            if gout is not None:
+                out = gout
+            elif use_mesh is not None and frame.nrows > 0:
                 from .parallel import verbs as _pverbs
 
                 out = _pverbs.fused_map_blocks(

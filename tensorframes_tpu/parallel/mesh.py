@@ -49,8 +49,21 @@ def mesh_2d(data: int, model: int, devices=None) -> Mesh:
     return Mesh(arr, ("data", "model"))
 
 
-def shard_to_mesh(mesh: Mesh, arr: np.ndarray) -> jax.Array:
-    """Place a host array sharded over the mesh's ``data`` axis (lead dim);
-    lead dim must be divisible by the data-axis size."""
+def shard_to_mesh(mesh: Mesh, arr) -> jax.Array:
+    """Place an array sharded over the mesh's ``data`` axis (lead dim).
+
+    A lead dim not divisible by the data-axis size is padded up to the
+    next multiple by replicating the last valid row
+    (`shape_policy.pad_lead` — the same numerically-ordinary padding
+    the bucket ladder uses), instead of the hard `device_put` failure
+    jax raises on uneven shards. The caller owns slicing the pad rows
+    back off (`GlobalFrame` tracks the valid row count and slices on
+    `collect`); masked reduces mask them to the reduction identity."""
+    ndata = mesh.shape["data"]
+    n = arr.shape[0]
+    if n % ndata:
+        from ..shape_policy import pad_lead
+
+        arr = pad_lead(arr, n, n + (ndata - n % ndata))
     spec = P("data", *([None] * (arr.ndim - 1)))
     return jax.device_put(arr, NamedSharding(mesh, spec))

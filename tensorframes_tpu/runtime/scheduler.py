@@ -53,6 +53,8 @@ __all__ = [
     "DeviceHealth",
     "device_health",
     "device_label",
+    "global_device_set",
+    "global_mode",
     "health_overview",
     "plan",
     "resolve",
@@ -60,7 +62,7 @@ __all__ = [
     "schedule_weights",
 ]
 
-_MODES = ("auto", "on", "off")
+_MODES = ("auto", "on", "off", "global")
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +321,47 @@ def _normalize_devices(devices) -> Tuple:
     return tuple(out)
 
 
+def global_mode() -> bool:
+    """True when ``config.block_scheduler == "global"`` — eligible verb
+    dispatches route through the `GlobalFrame` SPMD path; everything
+    ineligible falls back to per-block scheduling (``resolve`` treats
+    the mode as "auto" for that fallback)."""
+    from .. import config as _config
+
+    return _config.get().block_scheduler == "global"
+
+
+def global_device_set() -> List:
+    """The local devices a `GlobalFrame` data mesh spans: every local
+    device whose failover circuit is closed. When circuit-open devices
+    shrink the set, say so LOUDLY — a shrunk mesh changes sharding (and
+    therefore which compiled program runs), which an operator debugging
+    throughput must be able to see. All circuits open falls back to the
+    full set (same last-resort rule as `resolve`)."""
+    devs = _local_devices()
+    healthy = _health.filter(devs)
+    if not healthy:
+        from ..utils.log import get_logger
+
+        get_logger("scheduler").warning(
+            "every local device's failover circuit is open; building "
+            "the global-frame mesh over the full device set anyway"
+        )
+        return devs
+    if len(healthy) < len(devs):
+        from ..utils.log import get_logger
+
+        get_logger("scheduler").warning(
+            "global-frame mesh shrunk to %d of %d local device(s): "
+            "%s circuit-open after transient failures",
+            len(healthy), len(devs),
+            ",".join(
+                device_label(d) for d in devs if d not in healthy
+            ),
+        )
+    return healthy
+
+
 def resolve(
     devices=None, executor=None, mesh=None
 ) -> Optional[Tuple]:
@@ -332,7 +375,10 @@ def resolve(
     otherwise ``config.block_scheduler``: "off" disables, "on" schedules
     onto all local devices (even one — useful to force the scheduled
     code path), "auto" (default) schedules only when >1 local device
-    exists."""
+    exists. "global" behaves like "auto" HERE: the GlobalFrame SPMD
+    routing happens above this call at the verb layer, and everything
+    that falls through (ineligible graphs, small frames) still deserves
+    per-block scheduling."""
     if mesh is not None:
         if devices is not None:
             raise ValueError(
@@ -376,12 +422,12 @@ def resolve(
         # the knob (same discipline as config.native_executor)
         raise ValueError(
             f"config.block_scheduler={mode!r} is not one of "
-            "'auto' | 'on' | 'off'"
+            "'auto' | 'on' | 'off' | 'global'"
         )
     if mode == "off":
         return None
     devs = _local_devices()
-    if mode == "auto" and len(devs) < 2:
+    if mode in ("auto", "global") and len(devs) < 2:
         return None
     # failover: circuit-open devices drop out of auto/on scheduling
     # until their cooldown elapses (then ONE half-open probe re-admits

@@ -9,6 +9,7 @@ surface is unchanged.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -143,16 +144,32 @@ def reduce_blocks_stream(
                 for b in parts[0]
             }
         )
-        r = _api.reduce_blocks(
-            graph, stacked, None, fetch_names=fetch_list, executor=executor,
-            # the combine honors the stream's device set (a pinned
-            # stream keeps its combine on the pinned device; rotation
-            # anchors it on sched_devs[0] where the stack landed)
-            devices=list(sched_devs) if sched_devs else None,
-        )
+        with contextlib.ExitStack() as _gstack:
+            if gmesh_off[0]:
+                # the stream already proved this graph unclassifiable:
+                # the combine must not re-probe (and re-count) it
+                from . import globalframe as _gfm
+
+                _gstack.enter_context(_gfm._suppress_route())
+            r = _api.reduce_blocks(
+                graph, stacked, None, fetch_names=fetch_list,
+                executor=executor,
+                # the combine honors the stream's device set (a pinned
+                # stream keeps its combine on the pinned device; rotation
+                # anchors it on sched_devs[0] where the stack landed)
+                devices=list(sched_devs) if sched_devs else None,
+            )
         return r if isinstance(r, dict) else {_base(fetch_list[0]): r}
 
     transfer_warned = [False]
+    # global-mode sharded transfer stands down for the REST of the
+    # stream once conversion fails or the consume loop's first-chunk
+    # eligibility gate finds the reduce graph unclassifiable (the
+    # transfer stage runs on a pipeline thread — the plain-list cell
+    # is the shared off switch, and a lagging read merely shards one
+    # extra chunk, which the consume loop converts back)
+    gmesh_off = [False]
+    gmesh_checked = [False]
     # Block-scheduled streams round-robin chunks over the device set:
     # the prefetch transfer stage targets the NEXT chunk's assigned
     # device, so each device's H2D copy double-buffers under the
@@ -165,11 +182,17 @@ def reduce_blocks_stream(
     consume_idx = [0]
 
     def _chunk_device(counter):
+        # the ordinal advances for EVERY chunk, even while rotation is
+        # off (sched_devs None): if the global path stands down
+        # mid-stream and rotation resumes, both the transfer stage and
+        # the consume loop must map chunk i to the same device, so the
+        # assignment has to key off the chunk ordinal, not off how many
+        # chunks each side happened to rotate
+        i = counter[0]
+        counter[0] += 1
         if not sched_devs:
             return None
-        dev = sched_devs[counter[0] % len(sched_devs)]
-        counter[0] += 1
-        return dev
+        return sched_devs[i % len(sched_devs)]
 
     def _to_device(f):
         # the transfer stage of the prefetch pipeline: issue the H2D
@@ -184,6 +207,33 @@ def reduce_blocks_stream(
         dev = _chunk_device(stage_idx)  # every item advances the ordinal
         from .lazy import LazyFrame
 
+        if gmesh is not None and isinstance(f, TensorFrame):
+            # global stream path: the transfer stage does the SHARDED
+            # device_put (per-shard H2D copies overlap under the
+            # previous chunk's compute), and the per-chunk reduce below
+            # folds into the sharded accumulator as ONE SPMD dispatch.
+            # Small/ineligible chunks stay plain and fall THROUGH to
+            # the ordinary per-block transfer below — they keep the
+            # H2D/compute overlap, the same fallback rule as the verbs.
+            from . import config as _cfg
+            from . import globalframe as _gf
+
+            if (
+                not gmesh_off[0]
+                and f.nrows >= max(1, _cfg.get().global_frame_min_rows)
+            ):
+                try:
+                    return _gf.GlobalFrame.from_frame(f, mesh=gmesh)
+                except Exception as e:
+                    gmesh_off[0] = True
+                    from .utils.log import get_logger
+
+                    get_logger("streaming").warning(
+                        "global sharded transfer disabled for this "
+                        "stream (%s: %s); chunks fall back to the "
+                        "per-block path",
+                        type(e).__name__, e,
+                    )
         if isinstance(f, (LazyFrame, TensorFrame)):
             try:
                 return f.to_device(device=dev)
@@ -227,6 +277,24 @@ def reduce_blocks_stream(
         # rotate. An EXPLICIT one-device list stays: rotation over one
         # device IS the documented pin (every chunk targets it).
         sched_devs = None
+    # block_scheduler="global": eligible chunks shard over ONE data
+    # mesh in the transfer stage and each chunk's reduce is a single
+    # SPMD dispatch — the global path owns placement, so the per-chunk
+    # device rotation stands down (an explicit devices= pin keeps it).
+    gmesh = None
+    gmesh_rotation = None
+    if local and devices is None and _rs.global_mode():
+        from . import globalframe as _gf
+
+        try:
+            gmesh = _gf.resolve_global_mesh()
+        except Exception:
+            gmesh = None
+        if gmesh is not None:
+            # parked, not dropped: if the stream stands the global path
+            # down (unclassifiable reduce, failed conversion), per-chunk
+            # rotation resumes — ineligible streams behave as "auto"
+            gmesh_rotation, sched_devs = sched_devs, None
     # Compose ONE stage graph for the whole ingest path. A plain
     # iterator of frames keeps the classic producer -> transfer shape;
     # an `IngestStream` (multi-file dataset from `stream_dataset` /
@@ -306,6 +374,13 @@ def reduce_blocks_stream(
         for f in pipelined(
             source, pipe_stages, depth=pipe_depth, ordinal_base=watermark
         ):
+            if gmesh_off[0] and gmesh_rotation is not None:
+                # the global path stood down: rotation resumes exactly
+                # as under "auto" (chunks transferred before the switch
+                # pay at most one implicit move onto their pinned
+                # device — bounded by the prefetch depth)
+                sched_devs = gmesh_rotation
+                gmesh_rotation = None
             chunk_dev = _chunk_device(consume_idx)
             nrows = len(f) if _api._is_pandas(f) else getattr(f, "nrows", None)
             if nrows == 0:
@@ -318,6 +393,37 @@ def reduce_blocks_stream(
                 # carries rows.
                 ordinal += 1
                 continue
+            if gmesh is not None:
+                from . import globalframe as _gfm
+
+                if isinstance(f, _gfm.GlobalFrame) and not gmesh_off[0]:
+                    if not gmesh_checked[0]:
+                        # the reduce graph is fixed for the stream's
+                        # lifetime: decide ONCE whether it lowers to
+                        # the one-dispatch collective program, instead
+                        # of paying a sharded H2D plus a local-boundary
+                        # fallback re-gather on every chunk
+                        gmesh_checked[0] = True
+                        if not _gfm.stream_reduce_eligible(
+                            graph, fetch_list, f, feed_dict, executor
+                        ):
+                            gmesh_off[0] = True
+                            # ONE counted reason for the whole stream,
+                            # not one per chunk
+                            _gfm._note_fallback("unclassified-reduce")
+                            from .utils.log import get_logger
+
+                            get_logger("streaming").warning(
+                                "global sharded transfer disabled for "
+                                "this stream: the reduce graph has no "
+                                "monoid structure to lower as an "
+                                "in-program collective; chunks take "
+                                "the per-block path"
+                            )
+                if gmesh_off[0] and isinstance(f, _gfm.GlobalFrame):
+                    # sharded before the off switch flipped (in-flight
+                    # prefetch, or the gate's own first chunk)
+                    f = f.to_frame()
             if auto_fold or (ckpt is not None and ckpt.monoids is None):
                 # classify once, on the first chunk: ONE analysis pass
                 # serves both the fold class (tree-fold only graphs
@@ -356,7 +462,16 @@ def reduce_blocks_stream(
             # profiling entirely (only the inner verb recorded); the chunk
             # record attributes each dispatch to the stream and carries the
             # chunk row count
-            with record("reduce_blocks_stream.chunk", int(nrows or 0)):
+            with record("reduce_blocks_stream.chunk", int(nrows or 0)), \
+                    contextlib.ExitStack() as _gstack:
+                if gmesh_off[0]:
+                    # the stream already decided against the global
+                    # path: stop the per-chunk auto-route from
+                    # re-probing (and re-counting a fallback for) the
+                    # same fixed graph on every chunk
+                    from . import globalframe as _gfm
+
+                    _gstack.enter_context(_gfm._suppress_route())
                 r = _api.reduce_blocks(
                     graph, f, feed_dict, fetch_names=fetch_list,
                     executor=executor, mesh=mesh,

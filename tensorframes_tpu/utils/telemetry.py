@@ -942,6 +942,12 @@ _PROM_HELP: Dict[str, str] = {
     ),
     "checkpoint_write_seconds": "Durable-stream checkpoint commit latency",
     "autotune_adjustments": "Knob adjustments applied by the autotuner",
+    "global_dispatches": "Single-program SPMD dispatches by verb",
+    "global_collectives": "In-program all-reduces lowered by global reduces",
+    "global_pad_rows": "Synthetic rows padded onto sharded lead dims",
+    "global_fallbacks": (
+        "Dispatches that left the global SPMD path, by reason"
+    ),
     "admission_wait_seconds": "Time spent queued for a verb slot",
     "admission_queue_depth": "Verbs queued for admission right now",
     "admission_in_flight": "Admitted top-level verbs in flight",
@@ -1184,6 +1190,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["checkpoint"] = _checkpoint.state()
     except Exception as e:
         data["checkpoint"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # global sharded frames: SPMD dispatch accounting --------------------
+    try:
+        from .. import globalframe as _globalframe
+
+        data["globalframe"] = _globalframe.state()
+    except Exception as e:
+        data["globalframe"] = {"error": f"{type(e).__name__}: {e}"}
 
     # executor + recompile-storm signal ---------------------------------
     try:
@@ -1513,6 +1527,22 @@ def _render_diagnostics(data: Dict) -> str:
                 f"  last resume: {lr['path']} "
                 f"watermark={lr['watermark']} partials={lr['partials']}"
             )
+
+    # global sharded frames ----------------------------------------------
+    gf = data.get("globalframe", {})
+    if gf and "error" not in gf and (
+        gf.get("frames") or gf.get("dispatches") or gf.get("fallbacks")
+    ):
+        lines.append("")
+        lines.append(
+            f"global frames: {gf.get('frames', 0)} frame(s) over "
+            f"{gf.get('shards') or '?'} shard(s), "
+            f"{gf.get('dispatches', 0)} SPMD dispatch(es), "
+            f"{gf.get('collectives', 0)} in-program collective(s), "
+            f"{gf.get('pad_rows', 0)} pad row(s) on sharded lead dims"
+        )
+        for reason, n in sorted(gf.get("fallbacks", {}).items()):
+            lines.append(f"  fallback {reason}: {n} dispatch(es)")
 
     # executor + recompile-storm signal ---------------------------------
     if "executor_error" in data:

@@ -38,7 +38,7 @@ def _reset_telemetry():
     (circuit breakers are process-global) and ledger counts must never
     bleed into the next test's scheduling."""
     yield
-    from tensorframes_tpu import config, serving
+    from tensorframes_tpu import config, globalframe, serving
     from tensorframes_tpu.runtime import (
         autotune,
         checkpoint,
@@ -58,3 +58,4 @@ def _reset_telemetry():
     costmodel.reset()
     deadline.reset()
     checkpoint.reset_state()  # durable-stream accounting never leaks
+    globalframe.reset_state()  # SPMD dispatch/fallback ledger never leaks

@@ -17,6 +17,7 @@ import struct
 import subprocess
 import sys
 import textwrap
+import threading
 import time
 
 import numpy as np
@@ -308,6 +309,16 @@ class TestCommitResume:
         manifest, _ = CheckpointStore(ck).load()
         assert manifest["watermark"] == wm
         assert manifest["monoids"] == {"s": "sum", "mn": "min", "mx": "max"}
+        # let the interrupted run's pipeline threads drain before the
+        # counter reset: a non-hung decode worker finishing its chunk
+        # AFTER reset would be charged to the resumed run and flake the
+        # decode-count bound below
+        end = time.time() + 10
+        while time.time() < end and any(
+            t.name.startswith("tfs-ingest")
+            for t in threading.enumerate()
+        ):
+            time.sleep(0.01)
         telemetry.reset()
         out = tfs.reduce_blocks_stream(
             fetches, tfs.stream_dataset(str(tmp_path)), _FEED,

@@ -143,9 +143,14 @@ class TestPrimitives:
             with dl.verb_scope("inner", timeout_s=0.05) as inner:
                 assert inner.remaining() <= 0.05 + 1e-6
             # an inner timeout LARGER than the outer budget cannot
-            # extend it: the inherited (tighter) deadline wins
+            # extend it: the inherited (tighter) deadline wins. Read
+            # the OUTER clock first: both scopes share one deadline,
+            # so the later (inner) read is necessarily <= the earlier
+            # one — reading inner first raced the monotonic clock and
+            # flaked by sub-microsecond jitter.
             with dl.verb_scope("inner2", timeout_s=100.0) as inner2:
-                assert inner2.remaining() <= outer.remaining() + 1e-6
+                outer_rem = outer.remaining()
+                assert inner2.remaining() <= outer_rem + 1e-6
             # nested scopes share the cancel event
             with dl.verb_scope("inner3") as inner3:
                 outer.cancel("stop")

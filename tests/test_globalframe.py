@@ -1,0 +1,759 @@
+"""GlobalFrame (ISSUE 14): sharded-array SPMD execution.
+
+The contract under test: a `GlobalFrame`'s columns are single
+`jax.Array`s sharded over a data mesh, every eligible verb on it is
+exactly ONE dispatch (asserted via spans, labeled ``sharding=data:N``),
+maps and min/max/int-sum reduces are bit-identical to the per-block
+scheduler path (float sum/mean within the documented reassociation
+tolerance), non-divisible lead dims pad-and-slice-back invisibly,
+circuit-open devices shrink the mesh, ``devices=``/``mesh=`` overrides
+are rejected loudly, and deadlines/admission still gate the
+single-dispatch boundary. ``block_scheduler="global"`` auto-routes
+plain-TensorFrame verbs through the same path above
+``global_frame_min_rows``.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config, dsl, globalframe
+from tensorframes_tpu.runtime.scheduler import device_health, device_label
+from tensorframes_tpu.utils import telemetry
+
+try:
+    # the parallel package __init__ pulls shard_map-dependent modules
+    # (jax >= 0.7); the GlobalFrame path itself never needs them
+    from tensorframes_tpu.parallel.mesh import shard_to_mesh
+except ImportError:  # pragma: no cover - old-jax local runs only
+    shard_to_mesh = None
+
+NDEV = len(jax.local_devices())
+
+multi_device = pytest.mark.skipif(
+    NDEV < 2, reason="needs >1 (virtual) local device"
+)
+
+
+def _frame(n=100, blocks=5, dtype=np.float32, mod=None, seed=0):
+    rng = np.random.RandomState(seed)
+    if mod is None:
+        data = rng.rand(n).astype(dtype)
+    else:
+        data = (np.arange(n) % mod).astype(dtype)
+    return tfs.TensorFrame.from_dict({"x": data}, num_blocks=blocks)
+
+
+def _reduce(df_like, op, col="x"):
+    ph = tfs.block(df_like, col, tf_name=col + "_input")
+    return {
+        "sum": dsl.reduce_sum,
+        "min": dsl.reduce_min,
+        "max": dsl.reduce_max,
+        "mean": dsl.reduce_mean,
+    }[op](ph, axes=[0]).named(col)
+
+
+def _dispatches(suffix=""):
+    return [
+        s
+        for s in telemetry.spans()
+        if s.kind == "dispatch" and s.name.endswith(suffix)
+    ]
+
+
+class TestConstruction:
+    def test_to_global_shards_and_pads(self):
+        df = _frame(19, blocks=4)
+        gf = df.to_global()
+        assert gf.nrows == 19
+        assert gf.data_size == NDEV
+        assert gf.padded_rows % NDEV == 0
+        assert gf.padded_rows >= 19
+        arr = gf.column("x").values
+        assert isinstance(arr, jax.Array)
+        assert len(arr.devices()) == NDEV
+        # collect slices the pad rows back off, bit-identically
+        np.testing.assert_array_equal(
+            np.asarray(gf.to_frame()["x"].values), np.asarray(df["x"].values)
+        )
+
+    def test_bucket_ladder_on_per_shard_dim(self):
+        # the per-shard lead dim sits on a ladder rung, so drifting
+        # global row counts reuse compiled shapes (warm-compile story)
+        from tensorframes_tpu.shape_policy import bucket_for
+
+        df = _frame(100)
+        gf = df.to_global()
+        per_shard = gf.padded_rows // gf.data_size
+        assert per_shard == bucket_for(-(-100 // gf.data_size))
+
+    def test_rejects_ragged_string_empty(self):
+        ragged = tfs.TensorFrame.from_dict(
+            {"r": [np.zeros(i + 1, np.float32) for i in range(4)]}
+        )
+        with pytest.raises(ValueError, match="dense device-shardable"):
+            ragged.to_global()
+        strings = tfs.TensorFrame.from_dict({"s": ["a", "b", "c"]})
+        with pytest.raises(ValueError, match="dense device-shardable"):
+            strings.to_global()
+        empty = tfs.TensorFrame.from_dict({"x": np.zeros(0, np.float32)})
+        with pytest.raises(ValueError, match="empty"):
+            empty.to_global()
+
+    def test_shard_to_mesh_pads_non_divisible(self):
+        # the satellite fix: non-divisible lead dims pad instead of
+        # raising out of device_put
+        if shard_to_mesh is None:
+            pytest.skip("parallel package needs jax.shard_map (>=0.7)")
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.local_devices()), ("data",))
+        arr = np.arange(NDEV * 2 + 3, dtype=np.float32)
+        out = shard_to_mesh(mesh, arr)
+        assert out.shape[0] % NDEV == 0
+        assert out.shape[0] >= arr.shape[0]
+        np.testing.assert_array_equal(
+            np.asarray(out)[: arr.shape[0]], arr
+        )
+        # pad rows replicate the last valid row (numerically ordinary)
+        np.testing.assert_array_equal(
+            np.asarray(out)[arr.shape[0]:],
+            np.broadcast_to(arr[-1:], (out.shape[0] - arr.shape[0],)),
+        )
+
+
+class TestParity:
+    """Bit-identity vs the block-scheduler path on the 8-device mesh."""
+
+    def test_map_bit_identical_one_dispatch(self):
+        df = _frame(100, blocks=5)
+        z = (tfs.block(df, "x") * 2.0 + 1.0).named("z")
+        with config.override(block_scheduler="on"):
+            ref = np.asarray(tfs.map_blocks(z, df)["z"].values)
+        telemetry.reset()
+        gout = df.to_global().map_blocks(z)
+        assert isinstance(gout, tfs.GlobalFrame)
+        np.testing.assert_array_equal(
+            np.asarray(gout.to_frame()["z"].values), ref
+        )
+        spans = _dispatches()
+        assert len(spans) == 1 and spans[0].name == "map_blocks.global"
+        assert spans[0].attrs["sharding"] == f"data:{NDEV}"
+
+    def test_chained_map_reduce_one_dispatch_per_stage(self):
+        # THE acceptance case: chained map -> reduce over the forced
+        # 8-device mesh issues exactly ONE verb dispatch per stage and
+        # min/max/int-sum are bit-identical to the scheduler path
+        df = _frame(1000, blocks=8, dtype=np.float64, mod=131)
+        dfi = _frame(1000, blocks=8, dtype=np.int64, mod=131)
+        z = (tfs.block(df, "x") * 3.0 - 1.0).named("z")
+
+        def zred(src, op):
+            ph = tfs.block(src, "z", tf_name="z_input")
+            return {
+                "min": dsl.reduce_min, "max": dsl.reduce_max,
+            }[op](ph, axes=[0]).named("z")
+
+        with config.override(block_scheduler="on"):
+            mref = tfs.map_blocks(z, df)
+            ref = {
+                op: float(
+                    np.asarray(tfs.reduce_blocks(zred(mref, op), mref))
+                )
+                for op in ("min", "max")
+            }
+            iref = int(
+                np.asarray(tfs.reduce_blocks(_reduce(dfi, "sum"), dfi))
+            )
+        telemetry.reset()
+        gf = df.to_global()
+        mapped = gf.map_blocks(z)
+        for op in ("min", "max"):
+            got = float(np.asarray(mapped.reduce_blocks(zred(mapped, op))))
+            assert got == ref[op], (op, got, ref[op])
+        isum = int(
+            np.asarray(dfi.to_global().reduce_blocks(_reduce(dfi, "sum")))
+        )
+        assert isum == iref
+        names = [s.name for s in _dispatches()]
+        # one map dispatch + one per reduce (min, max, int-sum); the
+        # to_global conversions are transfers, not dispatches
+        assert names.count("map_blocks.global") == 1, names
+        assert names.count("reduce_blocks.global") == 3, names
+        assert len(names) == 4, names
+        for s in _dispatches():
+            assert s.attrs["sharding"] == f"data:{NDEV}"
+
+    def test_sum_mean_within_tolerance(self):
+        df = _frame(1024, blocks=8, dtype=np.float32)
+        gf = df.to_global()
+        with config.override(block_scheduler="on"):
+            sref = float(
+                np.asarray(tfs.reduce_blocks(_reduce(df, "sum"), df))
+            )
+            mref = float(
+                np.asarray(tfs.reduce_blocks(_reduce(df, "mean"), df))
+            )
+        s = float(np.asarray(gf.reduce_blocks(_reduce(df, "sum"))))
+        m = float(np.asarray(gf.reduce_blocks(_reduce(df, "mean"))))
+        np.testing.assert_allclose(s, sref, rtol=1e-5)
+        np.testing.assert_allclose(m, mref, rtol=1e-5)
+
+    def test_non_divisible_lead_dims(self):
+        # every awkward row count round-trips exactly through the
+        # padded sharded lead dim (maps slice, reduces mask)
+        for n in (NDEV - 1, NDEV + 1, 2 * NDEV + 3, 97):
+            df = _frame(max(n, 1), blocks=min(3, max(n, 1)), mod=13)
+            z = (tfs.block(df, "x") + 0.5).named("z")
+            gf = df.to_global()
+            np.testing.assert_array_equal(
+                np.asarray(gf.map_blocks(z).to_frame()["z"].values),
+                np.asarray(tfs.map_blocks(z, df)["z"].values),
+            )
+            gmin = float(np.asarray(gf.reduce_blocks(_reduce(df, "min"))))
+            rmin = float(
+                np.asarray(tfs.reduce_blocks(_reduce(df, "min"), df))
+            )
+            assert gmin == rmin, (n, gmin, rmin)
+
+    def test_map_rows_global_one_dispatch(self):
+        df = _frame(64, blocks=4)
+        r = tfs.row(df, "x")
+        y = (r * r).named("y")
+        ref = np.asarray(tfs.map_rows(y, df)["y"].values)
+        telemetry.reset()
+        gout = df.to_global().map_rows(y)
+        np.testing.assert_array_equal(
+            np.asarray(gout.to_frame()["y"].values), ref
+        )
+        spans = _dispatches()
+        assert [s.name for s in spans] == ["map_rows.global"]
+
+    def test_multi_fetch_reduce(self):
+        df = _frame(200, blocks=4, mod=29)
+        xin = tfs.block(df, "x", tf_name="x_input")
+        fetches = [
+            dsl.reduce_min(xin, axes=[0]).named("x"),
+        ]
+        # multi-fetch via separate columns: x min + y max
+        df2 = df.with_columns(
+            [tfs.Column("y", np.asarray(df["x"].values) * -1.0)]
+        )
+        yin = tfs.block(df2, "y", tf_name="y_input")
+        multi = [
+            dsl.reduce_min(xin, axes=[0]).named("x"),
+            dsl.reduce_max(yin, axes=[0]).named("y"),
+        ]
+        ref = tfs.reduce_blocks(multi, df2)
+        got = df2.to_global().reduce_blocks(multi)
+        assert set(got) == set(ref)
+        for k in ref:
+            assert float(np.asarray(got[k])) == float(np.asarray(ref[k]))
+
+
+class TestFallbacks:
+    def test_unclassified_reduce_falls_back(self):
+        # sum(x)+1 is not a monoid combine: the global path crosses the
+        # local boundary (one logical block) and counts the fallback
+        df = _frame(50, blocks=5)
+        xin = tfs.block(df, "x", tf_name="x_input")
+        g = (dsl.reduce_sum(xin, axes=[0]) + 1.0).named("x")
+        single = tfs.TensorFrame.from_dict(
+            {"x": np.asarray(df["x"].values)}
+        )
+        globalframe.reset_state()
+        v = df.to_global().reduce_blocks(g)
+        ref = tfs.reduce_blocks(g, single)  # one block = the global view
+        np.testing.assert_allclose(
+            float(np.asarray(v)), float(np.asarray(ref)), rtol=1e-6
+        )
+        assert globalframe.state()["fallbacks"] == {
+            "unclassified-reduce": 1
+        }
+
+    def test_non_row_local_map_falls_back(self):
+        # a block-level normalization (subtract the block sum) is not
+        # row-local: it runs on the local boundary, result still exact
+        df = _frame(40, blocks=1)
+        x = tfs.block(df, "x")
+        g = (x - dsl.reduce_sum(x, axes=[0])).named("z")
+        globalframe.reset_state()
+        gout = df.to_global().map_blocks(g)
+        assert isinstance(gout, tfs.GlobalFrame)
+        ref = tfs.map_blocks(g, df)
+        np.testing.assert_allclose(
+            np.asarray(gout.to_frame()["z"].values),
+            np.asarray(ref["z"].values),
+            rtol=1e-6,
+        )
+        assert "not-row-local" in globalframe.state()["fallbacks"]
+
+    def test_trim_rejected(self):
+        df = _frame(16)
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        with pytest.raises(ValueError, match="trim"):
+            df.to_global().map_blocks(z, trim=True)
+
+    def test_fallback_counted_once_under_global_mode(self):
+        # the fallback re-enters the verb layer over to_frame(); under
+        # block_scheduler="global" the auto-route must not probe (and
+        # count a second fallback for) that very dispatch
+        df = _frame(50, blocks=5)
+        xin = tfs.block(df, "x", tf_name="x_input")
+        g = (dsl.reduce_sum(xin, axes=[0]) + 1.0).named("x")
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            df.to_global().reduce_blocks(g)
+        assert globalframe.state()["fallbacks"] == {
+            "unclassified-reduce": 1
+        }
+        x = tfs.block(df, "x")
+        nr = (x - dsl.reduce_sum(x, axes=[0])).named("z")
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            df.to_global().map_blocks(nr)
+        assert globalframe.state()["fallbacks"] == {"not-row-local": 1}
+
+    def test_reduce_rows_and_aggregate_take_local_path(self):
+        df = _frame(60, blocks=3, mod=7)
+        r1 = tfs.row(df, "x", tf_name="x_1")
+        r2 = tfs.row(df, "x", tf_name="x_2")
+        fold = (r1 + r2).named("x")
+        np.testing.assert_allclose(
+            float(np.asarray(df.to_global().reduce_rows(fold))),
+            float(np.asarray(tfs.reduce_rows(fold, df))),
+            rtol=1e-6,
+        )
+        dfk = tfs.TensorFrame.from_dict(
+            {
+                "k": (np.arange(60) % 3).astype(np.int64),
+                "x": np.asarray(df["x"].values),
+            }
+        )
+        agg = dfk.to_global().group_by("k").aggregate(
+            _reduce(dfk, "sum")
+        )
+        ref = dfk.group_by("k").aggregate(_reduce(dfk, "sum"))
+        np.testing.assert_allclose(
+            np.asarray(agg["x"].host_values()),
+            np.asarray(ref["x"].host_values()),
+            rtol=1e-5,
+        )
+
+
+class TestPrecedence:
+    def test_devices_rejected_loudly(self):
+        df = _frame(32)
+        gf = df.to_global()
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        with pytest.raises(ValueError, match="devices="):
+            gf.map_blocks(z, devices=[0])
+        with pytest.raises(ValueError, match="devices="):
+            gf.reduce_blocks(_reduce(df, "min"), devices=[0])
+        with pytest.raises(ValueError, match="devices="):
+            gf.map_rows(
+                (tfs.row(df, "x") * 2.0).named("y"), devices=[0]
+            )
+
+    def test_mesh_rejected_loudly(self):
+        df = _frame(32)
+        gf = df.to_global()
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        with pytest.raises(ValueError, match="mesh="):
+            gf.map_blocks(z, mesh=gf.mesh)
+        with pytest.raises(ValueError, match="mesh="):
+            gf.reduce_blocks(_reduce(df, "min"), mesh=gf.mesh)
+
+    def test_local_path_verbs_reject_overrides_too(self):
+        # reduce_rows and keyed aggregate always cross to the local
+        # boundary — but the frame still owns its placement, so the
+        # documented loud rejection holds on them as well
+        df = _frame(32)
+        gf = df.to_global()
+        r1 = tfs.row(df, "x", tf_name="x_1")
+        r2 = tfs.row(df, "x", tf_name="x_2")
+        fold = (r1 + r2).named("x")
+        with pytest.raises(ValueError, match="devices="):
+            gf.reduce_rows(fold, devices=[0])
+        with pytest.raises(ValueError, match="mesh="):
+            gf.reduce_rows(fold, mesh=gf.mesh)
+        dfk = tfs.TensorFrame.from_dict(
+            {
+                "k": (np.arange(32) % 2).astype(np.int64),
+                "x": np.arange(32, dtype=np.float32),
+            }
+        )
+        gk = dfk.to_global().group_by("k")
+        with pytest.raises(ValueError, match="devices="):
+            gk.aggregate(_reduce(dfk, "sum"), devices=[0])
+        with pytest.raises(ValueError, match="mesh="):
+            gk.aggregate(_reduce(dfk, "sum"), mesh=dfk.to_global().mesh)
+        # a plain-frame GroupedFrame keeps accepting overrides
+        assert not getattr(dfk.group_by("k"), "_from_global")
+
+    def test_global_mode_devices_pin_wins(self):
+        # an explicit per-call devices= pin keeps the per-block path
+        # even under block_scheduler="global" (pins win, always)
+        df = _frame(64, blocks=4)
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        telemetry.reset()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            out = tfs.map_blocks(z, df, devices=[0])
+        assert isinstance(out, tfs.TensorFrame)
+        assert not any(
+            s.name.endswith(".global") for s in _dispatches()
+        )
+
+
+@multi_device
+class TestMeshShrink:
+    def test_circuit_open_shrinks_mesh(self):
+        df = _frame(64)
+        lab = device_label(jax.local_devices()[NDEV - 1])
+        device_health().mark_failure(lab)
+        try:
+            gf = df.to_global()
+            assert gf.data_size == NDEV - 1
+            # the shrunk mesh still computes exact results
+            assert float(
+                np.asarray(gf.reduce_blocks(_reduce(df, "min")))
+            ) == float(np.asarray(df["x"].values).min())
+        finally:
+            device_health().reset()
+
+    def test_healthy_mesh_restored_after_reset(self):
+        df = _frame(64)
+        device_health().mark_failure(
+            device_label(jax.local_devices()[0])
+        )
+        assert df.to_global().data_size == NDEV - 1
+        device_health().reset()
+        assert df.to_global().data_size == NDEV
+
+
+class TestGlobalMode:
+    def test_auto_route_map_and_reduce(self):
+        df = _frame(100, blocks=5)
+        z = (tfs.block(df, "x") * 2.0 + 1.0).named("z")
+        with config.override(block_scheduler="off"):
+            mref = np.asarray(tfs.map_blocks(z, df)["z"].values)
+            sref = float(
+                np.asarray(tfs.reduce_blocks(_reduce(df, "min"), df))
+            )
+        telemetry.reset()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            out = tfs.map_blocks(z, df)
+            got = float(
+                np.asarray(tfs.reduce_blocks(_reduce(df, "min"), df))
+            )
+        # plain-TensorFrame surface: type, offsets and values unchanged
+        assert isinstance(out, tfs.TensorFrame)
+        assert out.offsets == df.offsets
+        np.testing.assert_array_equal(np.asarray(out["z"].values), mref)
+        assert got == sref
+        names = [s.name for s in _dispatches()]
+        assert "map_blocks.global" in names
+        assert "reduce_blocks.global" in names
+        assert "map_blocks.block" not in names
+
+    def test_min_rows_falls_back_to_per_block(self):
+        df = _frame(100, blocks=5)
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        telemetry.reset()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=10_000
+        ):
+            out = tfs.map_blocks(z, df)
+        assert isinstance(out, tfs.TensorFrame)
+        assert not any(
+            s.name.endswith(".global") for s in _dispatches()
+        )
+
+    def test_map_rows_auto_route(self):
+        df = _frame(64, blocks=4)
+        y = (tfs.row(df, "x") + 1.0).named("y")
+        with config.override(block_scheduler="off"):
+            ref = np.asarray(tfs.map_rows(y, df)["y"].values)
+        telemetry.reset()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            out = tfs.map_rows(y, df)
+        np.testing.assert_array_equal(np.asarray(out["y"].values), ref)
+        assert any(
+            s.name == "map_rows.global" for s in _dispatches()
+        )
+
+    def test_env_value_accepted(self):
+        # "global" is a valid block_scheduler mode end to end
+        from tensorframes_tpu.runtime import scheduler as rs
+
+        with config.override(block_scheduler="global"):
+            assert rs.global_mode()
+            assert rs.resolve() is not None or NDEV < 2
+        with config.override(block_scheduler="typo"):
+            with pytest.raises(ValueError, match="global"):
+                rs.resolve()
+
+    def test_knob_pins_respected(self):
+        # global_frame_min_rows rides the autotuner pin layer
+        assert config.set_tuned("global_frame_min_rows", 512)
+        assert config.tuned()["global_frame_min_rows"] == 512
+        config.reset_tuning()
+        with config.override(global_frame_min_rows=4096):
+            assert config.is_explicit("global_frame_min_rows")
+            assert not config.set_tuned("global_frame_min_rows", 64)
+        config.reset_tuning()
+
+
+class TestLazy:
+    def test_fused_chain_one_dispatch(self):
+        df = _frame(100, blocks=5)
+        z = (tfs.block(df, "x") * 2.0 + 1.0).named("z")
+        with config.override(block_scheduler="off"):
+            ref = tfs.map_blocks(z, df)
+            ref2 = tfs.map_blocks(
+                (tfs.block(ref, "z") * 3.0).named("w"), ref
+            )
+        gf = df.to_global()
+        telemetry.reset()
+        forced = (
+            gf.lazy()
+            .map_blocks(z)
+            .map_blocks((tfs.block(ref, "z") * 3.0).named("w"))
+            .force()
+        )
+        assert isinstance(forced, tfs.TensorFrame)
+        np.testing.assert_array_equal(
+            np.asarray(forced["w"].values), np.asarray(ref2["w"].values)
+        )
+        assert [s.name for s in _dispatches()] == ["lazy.force.global"]
+
+    def test_fused_reduce_one_dispatch(self):
+        df = _frame(100, blocks=5)
+        z = (tfs.block(df, "x") * 2.0 + 1.0).named("z")
+        with config.override(block_scheduler="off"):
+            ref = tfs.map_blocks(z, df)
+            rmin = float(
+                np.asarray(
+                    tfs.reduce_blocks(
+                        dsl.reduce_min(
+                            tfs.block(ref, "z", tf_name="z_input"),
+                            axes=[0],
+                        ).named("z"),
+                        ref,
+                    )
+                )
+            )
+        gf = df.to_global()
+        telemetry.reset()
+        got = gf.lazy().map_blocks(z).reduce_blocks(
+            dsl.reduce_min(
+                tfs.block(ref, "z", tf_name="z_input"), axes=[0]
+            ).named("z")
+        )
+        assert float(np.asarray(got)) == rmin
+        assert [s.name for s in _dispatches()] == [
+            "reduce_blocks.fused.global"
+        ]
+
+
+class TestStreaming:
+    def test_stream_folds_into_sharded_accumulator(self):
+        rng = np.random.RandomState(3)
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": rng.rand(50 + i).astype(np.float64)}
+            )
+            for i in range(4)
+        ]
+        ref = min(float(np.asarray(c["x"].values).min()) for c in chunks)
+        total = sum(
+            float(np.asarray(c["x"].values).sum()) for c in chunks
+        )
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            got_min = tfs.reduce_blocks_stream(
+                _reduce(chunks[0], "min"), iter(chunks)
+            )
+            got_sum = tfs.reduce_blocks_stream(
+                _reduce(chunks[0], "sum"), iter(chunks)
+            )
+        assert float(np.asarray(got_min)) == ref
+        np.testing.assert_allclose(
+            float(np.asarray(got_sum)), total, rtol=1e-6
+        )
+        st = globalframe.state()
+        assert st["dispatches"] >= len(chunks)
+        assert st["shards"] == NDEV
+
+    def test_small_chunks_fall_back(self):
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": np.arange(4, dtype=np.float64)}
+            )
+            for _ in range(3)
+        ]
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1000
+        ):
+            got = tfs.reduce_blocks_stream(
+                _reduce(chunks[0], "max"), iter(chunks)
+            )
+        assert float(np.asarray(got)) == 3.0
+        assert globalframe.state()["dispatches"] == 0
+
+    def test_unclassifiable_reduce_disables_sharding_once(self):
+        # the reduce graph is fixed for the stream's lifetime: an
+        # unclassifiable one stands the sharded transfer down at the
+        # FIRST chunk — one counted reason, zero global dispatches,
+        # not a sharded H2D + fallback re-gather per chunk
+        rng = np.random.RandomState(5)
+        chunks = [
+            tfs.TensorFrame.from_dict(
+                {"x": rng.rand(64).astype(np.float64)}
+            )
+            for _ in range(4)
+        ]
+        xin = tfs.block(chunks[0], "x", tf_name="x_input")
+        g = (dsl.reduce_sum(xin, axes=[0]) + 1.0).named("x")
+        with config.override(block_scheduler="on"):
+            ref = tfs.reduce_blocks_stream(g, iter(chunks))
+        globalframe.reset_state()
+        telemetry.reset()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            got = tfs.reduce_blocks_stream(g, iter(chunks))
+        np.testing.assert_allclose(
+            float(np.asarray(got)), float(np.asarray(ref)), rtol=1e-6
+        )
+        st = globalframe.state()
+        assert st["dispatches"] == 0
+        assert st["fallbacks"] == {"unclassified-reduce": 1}
+        if NDEV >= 2:
+            # the stand-down resumes per-chunk device rotation: the
+            # stream behaves exactly as under "auto", not serialized
+            # onto one device
+            devs = {
+                s.attrs.get("device")
+                for s in _dispatches()
+                if s.attrs.get("device") and (s.attrs.get("rows") or 0) > 10
+            }
+            assert len(devs) >= 2, devs
+
+
+class TestRuntimeBoundary:
+    def test_deadline_enforced_at_dispatch(self):
+        df = _frame(64)
+        gf = df.to_global()
+        with pytest.raises(tfs.DeadlineExceeded):
+            with tfs.deadline_scope(timeout_s=0.01):
+                time.sleep(0.05)
+                gf.reduce_blocks(_reduce(df, "min"))
+
+    def test_admission_no_deadlock_under_limit_one(self):
+        # the single dispatch takes one admission slot; internal work
+        # (conversion, fallback verbs) never takes a second one
+        df = _frame(64, blocks=4)
+        z = (tfs.block(df, "x") * 2.0).named("z")
+        with config.override(max_concurrent_verbs=1):
+            gf = df.to_global()
+            out = gf.map_blocks(z)
+            v = out.reduce_blocks(
+                dsl.reduce_min(
+                    tfs.block(out, "z", tf_name="z_input"), axes=[0]
+                ).named("z")
+            )
+        assert np.isfinite(float(np.asarray(v)))
+
+    def test_check_numerics_names_global_dispatch(self):
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.array([1.0, 0.0, 2.0], np.float32)}
+        )
+        g = (tfs.block(df, "x") / 0.0).named("z")
+        with config.override(check_numerics=True):
+            with pytest.raises(FloatingPointError, match="global"):
+                df.to_global().map_blocks(g)
+
+
+class TestObservability:
+    def test_diagnostics_section(self):
+        df = _frame(37, blocks=3)
+        globalframe.reset_state()
+        gf = df.to_global()
+        gf.reduce_blocks(_reduce(df, "min"))
+        data = telemetry.diagnostics_data()
+        sec = data["globalframe"]
+        assert sec["frames"] == 1
+        assert sec["dispatches"] == 1
+        assert sec["collectives"] == 1
+        assert sec["shards"] == NDEV
+        assert sec["pad_rows"] == gf.padded_rows - gf.nrows
+        text = tfs.diagnostics()
+        assert "global frames:" in text
+        assert f"{NDEV} shard(s)" in text
+
+    def test_cost_ledger_records_program_once(self):
+        # the sharded program is ONE ledger entry with exec counts per
+        # dispatch — never one per shard
+        from tensorframes_tpu.runtime import costmodel
+
+        df = _frame(128, blocks=4)
+        gf = df.to_global()
+        costmodel.reset()
+        gf.reduce_blocks(_reduce(df, "min"))
+        gf.reduce_blocks(_reduce(df, "min"))
+        progs = costmodel.program_costs()
+        ours = [
+            p for p in progs.values() if "global-reduce" in p["kinds"]
+        ]
+        assert len(ours) == 1, list(progs)
+        assert ours[0]["execs"] == 2
+        assert ours[0]["shapes"] == 1  # one sharded shape, not per-shard
+
+    def test_fallback_counter_labels(self):
+        df = _frame(32, blocks=2)
+        xin = tfs.block(df, "x", tf_name="x_input")
+        g = (dsl.reduce_sum(xin, axes=[0]) + 1.0).named("x")
+        globalframe.reset_state()
+        df.to_global().reduce_blocks(g)
+        counters = telemetry.labeled_counters()
+        assert any(
+            name == "global_fallbacks"
+            and dict(labels).get("reason") == "unclassified-reduce"
+            for (name, labels), _v in counters.items()
+        )
+
+
+class TestWarmCompiles:
+    def test_zero_steady_state_compiles_across_row_drift(self):
+        # different row counts that bucket to the same per-shard rung
+        # reuse ONE compiled sharded program
+        from tensorframes_tpu.runtime.executor import default_executor
+
+        ex = default_executor()
+        df1 = _frame(96, blocks=3, seed=1)
+        gf1 = df1.to_global()
+        gf1.reduce_blocks(_reduce(df1, "min"))
+        n0 = ex.jit_shape_compiles()
+        for n in (97, 99, 101, 103):
+            df = _frame(n, blocks=3, seed=n)
+            df.to_global().reduce_blocks(_reduce(df, "min"))
+        assert ex.jit_shape_compiles() == n0

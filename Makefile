@@ -13,6 +13,12 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
 
+# repo-invariant static analysis (tools/tfslint): lock discipline,
+# telemetry-registry parity, config env/docs parity, thread/reset
+# hygiene, fault typing, export/docs parity. Pure stdlib — no deps.
+lint:
+	$(PY) -m tools.tfslint tensorframes_tpu/
+
 # headline metric on whatever backend is live (real chip under axon)
 bench:
 	$(PY) bench.py

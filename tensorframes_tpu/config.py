@@ -93,7 +93,8 @@ def _env_int(var: str, default: int, field: str,
     return v if minimum is None else max(minimum, v)
 
 
-def _env_float(var: str, default: float, field: str) -> float:
+def _env_float(var: str, default: float, field: str,
+               minimum: Optional[float] = None) -> float:
     import os
 
     raw = os.environ.get(var)
@@ -104,19 +105,24 @@ def _env_float(var: str, default: float, field: str) -> float:
     except (TypeError, ValueError):
         return default  # malformed env never breaks the import
     _ENV_SEEDED.add(field)
-    return v
+    return v if minimum is None else max(minimum, v)
 
 
 def _env_str(var: str, default: str, field: str,
-             mapping: Optional[dict] = None) -> str:
+             mapping: Optional[dict] = None,
+             choices: Optional[tuple] = None) -> str:
     import os
 
     raw = os.environ.get(var)
     if raw is None or raw == "":
         return default
-    _ENV_SEEDED.add(field)
     low = raw.lower()
-    return mapping.get(low, low) if mapping is not None else raw
+    val = mapping.get(low, low) if mapping is not None else raw
+    if choices is not None and val not in choices:
+        return default  # an out-of-vocabulary value is malformed:
+        # default value, no pin — same contract as a typo'd number
+    _ENV_SEEDED.add(field)
+    return val
 
 
 def _env_histogram_buckets():
@@ -142,11 +148,26 @@ def _env_histogram_buckets():
 
 @dataclasses.dataclass
 class Config:
-    matmul_precision: str = "highest"
+    # Every SCALAR knob seeds from TFS_<KNOB> through the _env_*
+    # helpers (tfslint TFS003 enforces the parity): a deployment tunes
+    # any of them without a code change, a well-formed value pins the
+    # knob against the autotuner, and a malformed value falls back to
+    # the default without breaking the import.
+    matmul_precision: str = dataclasses.field(
+        default_factory=lambda: _env_str(
+            "TFS_MATMUL_PRECISION", "highest", "matmul_precision",
+            mapping={}, choices=("highest", "default", "tensorfloat32"),
+        )
+    )
     default_num_blocks: Optional[int] = None
     default_mesh: Optional[object] = None
     compilation_cache_dir: Optional[str] = None
-    aggregate_buffer_rows: int = 10
+    aggregate_buffer_rows: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_AGGREGATE_BUFFER_ROWS", 10, "aggregate_buffer_rows",
+            minimum=1,
+        )
+    )
     # aggregate: above this many DISTINCT group sizes, graphs classified
     # as Reduce(rowwise(placeholder), axis=0) (api._chunk_combiners:
     # Sum/Min/Max/Prod, float Mean) switch from the exact
@@ -154,14 +175,23 @@ class Config:
     # derived-monoid combine — compiles O(log max_size) instead of
     # O(#distinct sizes). Unclassifiable graphs always stay on the exact
     # plan (correct, but compile-heavy under pathological distributions).
-    aggregate_exact_size_limit: int = 32
+    aggregate_exact_size_limit: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_AGGREGATE_EXACT_SIZE_LIMIT", 32,
+            "aggregate_exact_size_limit", minimum=0,
+        )
+    )
     # aggregate: sort-free fast path for classified monoid graphs — the
     # rowwise transform runs over ALL rows in one XLA call and one
     # device segment_<op> per fetch replaces the argsort + per-size
     # plans entirely (host argsort dominated keyed aggregation at the
     # 10M-row TPU benchmark scale). Accumulation order differs from the
     # exact whole-group plan (FP reassociation). Off = exact/chunk plans.
-    aggregate_segment_fast: bool = True
+    aggregate_segment_fast: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_AGGREGATE_SEGMENT_FAST", True, "aggregate_segment_fast"
+        )
+    )
     # aggregate: float Sum/Mean segment tables with at most this many
     # DISTINCT KEYS compute as a one-hot matmul on the MXU instead of
     # XLA's scatter-add lowering of segment_sum (scatter serializes on
@@ -173,7 +203,12 @@ class Config:
     # Executor compile-cache bound (LRU): long-lived services whose
     # graphs / shapes drift would otherwise accumulate compiled
     # executables forever (the cache is never cleared implicitly).
-    executor_cache_entries: int = 512
+    executor_cache_entries: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_EXECUTOR_CACHE_ENTRIES", 512, "executor_cache_entries",
+            minimum=1,
+        )
+    )
     # Shape-bucketed block execution (`shape_policy`): pad every block
     # feed up to a geometric row-bucket ladder and mask/slice the pad
     # rows, so a workload with arbitrary drifting block sizes compiles
@@ -194,8 +229,19 @@ class Config:
     # Bucket-ladder geometry: rung k holds min * growth^k rows. Growth
     # trades pad waste (worst-case (growth-1)/growth of a block) against
     # ladder length (compile count ~ log_growth(max rows)).
-    shape_bucket_growth: float = 2.0
-    shape_bucket_min: int = 8
+    shape_bucket_growth: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_SHAPE_BUCKET_GROWTH", 2.0, "shape_bucket_growth",
+            # the ladder needs growth > 1 to be finite; 1.05 is the
+            # autotuner's own SAFETY_BOUNDS floor
+            minimum=1.05,
+        )
+    )
+    shape_bucket_min: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_SHAPE_BUCKET_MIN", 8, "shape_bucket_min", minimum=1
+        )
+    )
     # Multi-device block scheduler (`runtime.scheduler`): non-mesh verbs
     # spread per-block dispatches across jax.local_devices() (size-aware
     # largest-first placement; feeds are device_put onto the assigned
@@ -304,7 +350,12 @@ class Config:
     # many distinct input shapes for a single cached program — the
     # recompile-storm signal `compile_count` (distinct lowered callables)
     # structurally cannot see. 0 disables the check.
-    recompile_warn_shapes: int = 16
+    recompile_warn_shapes: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_RECOMPILE_WARN_SHAPES", 16, "recompile_warn_shapes",
+            minimum=0,
+        )
+    )
     # Telemetry master switch (`utils.telemetry`): span recording,
     # histogram observation and jax TraceAnnotation mirroring for every
     # verb / plan stage / per-block dispatch / compile event. Off =
@@ -319,7 +370,12 @@ class Config:
     # keeps the freshest N spans and counts what fell off — memory stays
     # O(N) no matter how long the process runs. Applied on
     # `telemetry.reset()` (the ring is rebuilt at the current value).
-    telemetry_ring_entries: int = 8192
+    telemetry_ring_entries: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_TELEMETRY_RING_ENTRIES", 8192, "telemetry_ring_entries",
+            minimum=1,
+        )
+    )
     # Live telemetry endpoint (`utils.telemetry_http`): when non-zero,
     # `tfs.telemetry.serve()` (and the import-time auto-start) binds an
     # HTTP server on this port serving /metrics (Prometheus text),
@@ -336,7 +392,11 @@ class Config:
             "TFS_TELEMETRY_PORT", 0, "telemetry_port"
         )
     )
-    telemetry_host: str = "127.0.0.1"
+    telemetry_host: str = dataclasses.field(
+        default_factory=lambda: _env_str(
+            "TFS_TELEMETRY_HOST", "127.0.0.1", "telemetry_host"
+        )
+    )
     # Histogram bucket boundaries (`utils.telemetry`): override the
     # fixed per-family ladders by bucket FAMILY ("seconds" | "rows" |
     # "bytes" | "fraction") or by exact metric name ("verb_seconds" —
@@ -362,7 +422,12 @@ class Config:
     # fitted over every attributed program, so a flag means "the model
     # misprices this program vs its peers", which is exactly what a
     # cost-based planner needs to distrust. 0 disables flagging.
-    cost_residual_warn_ratio: float = 4.0
+    cost_residual_warn_ratio: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_COST_RESIDUAL_WARN_RATIO", 4.0,
+            "cost_residual_warn_ratio", minimum=0.0,
+        )
+    )
     # Always-on cost/memory ledger (`runtime.costmodel`): every XLA
     # shape specialization of a cached program captures the compiler's
     # modeled flops / HBM bytes (from the lowered module's cost
@@ -385,7 +450,11 @@ class Config:
     # compile cost of every new program shape, so it is opt-in; with it
     # off the modeled footprint is argument + output bytes and
     # `temp_bytes` reads honest None.
-    cost_ledger_memory: bool = False
+    cost_ledger_memory: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_COST_LEDGER_MEMORY", False, "cost_ledger_memory"
+        )
+    )
     # Fault-tolerant dispatch (`runtime.faults`): every block execution
     # is a pure function of (compiled executable, block arrays) — the
     # property the reference leaned on for Spark task retry — so a
@@ -400,26 +469,57 @@ class Config:
     # block_retry_attempts: extra attempts per block dispatch for
     # transient errors (changed semantics vs the pre-classification
     # blanket retry, which burned attempts on deterministic errors too).
-    block_retry_attempts: int = 3
+    block_retry_attempts: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_BLOCK_RETRY_ATTEMPTS", 3, "block_retry_attempts",
+            minimum=0,
+        )
+    )
     # verb_retry_budget: total transient retries ONE verb call may spend
     # across all its block dispatches — bounds the worst-case stall of a
     # verb over many blocks on a flapping device.
-    verb_retry_budget: int = 32
+    verb_retry_budget: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_VERB_RETRY_BUDGET", 32, "verb_retry_budget", minimum=0
+        )
+    )
     # Exponential backoff between transient retries: base * 2^(k-1)
     # capped at max, times a DETERMINISTIC jitter factor in
     # [1, 1+retry_jitter] seeded by (retry_seed, dispatch, attempt) —
     # reruns sleep the same schedule, so fault-injected tests reproduce.
-    retry_backoff_base_s: float = 0.05
-    retry_backoff_max_s: float = 2.0
-    retry_jitter: float = 0.25
-    retry_seed: int = 0
+    retry_backoff_base_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_RETRY_BACKOFF_BASE_S", 0.05, "retry_backoff_base_s",
+            # a negative backoff would feed time.sleep() a ValueError
+            # mid-retry — clamp, mirroring the int helpers' minimum=
+            minimum=0.0,
+        )
+    )
+    retry_backoff_max_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_RETRY_BACKOFF_MAX_S", 2.0, "retry_backoff_max_s",
+            minimum=0.0,
+        )
+    )
+    retry_jitter: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_RETRY_JITTER", 0.25, "retry_jitter", minimum=0.0
+        )
+    )
+    retry_seed: int = dataclasses.field(
+        default_factory=lambda: _env_int("TFS_RETRY_SEED", 0, "retry_seed")
+    )
     # OOM graceful degradation: a resource-classified block dispatch
     # splits the block in half (down the shape-bucketing ladder) and
     # re-dispatches, up to this many recursive halvings. Row-local maps
     # concatenate the halves; monoid-classified reduces combine them
     # (size-weighted for mean); unclassifiable graphs re-raise the
     # original error exactly. 0 disables splitting.
-    oom_split_depth: int = 3
+    oom_split_depth: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_OOM_SPLIT_DEPTH", 3, "oom_split_depth", minimum=0
+        )
+    )
     # Device failover (`runtime.scheduler.DeviceHealth`): a transient
     # dispatch failure opens the device's circuit for this many seconds
     # (doubling on repeated failures, capped at 8x); its unissued blocks
@@ -427,7 +527,12 @@ class Config:
     # half-open probe dispatch re-admits it on success. Explicit
     # ``devices=`` pins opt out of failover (with a loud warning when a
     # pinned device is circuit-open).
-    device_cooldown_s: float = 30.0
+    device_cooldown_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_DEVICE_COOLDOWN_S", 30.0, "device_cooldown_s",
+            minimum=0.0,
+        )
+    )
     # Deadline / cancellation (`runtime.deadline`): default time budget
     # for a TOP-LEVEL verb call when no per-call timeout_s= is given
     # (0 = unbounded, the library default). The budget is an ABSOLUTE
@@ -470,7 +575,12 @@ class Config:
     # bounded only by the caller's deadline — do not combine 0 with
     # un-deadlined callers in a service, or a stuck verb strands its
     # whole queue.
-    admission_wait_timeout_s: float = 30.0
+    admission_wait_timeout_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_ADMISSION_WAIT_TIMEOUT_S", 30.0,
+            "admission_wait_timeout_s", minimum=0.0,
+        )
+    )
     # Serving runtime (`serving/`): the multi-tenant front-end that
     # keeps registered endpoint programs warm and coalesces concurrent
     # small requests into one bucketed dispatch.
@@ -527,7 +637,11 @@ class Config:
     # serve_max_batch_rows at `serving.register()` time (row-local
     # endpoints only — others cannot pad, so rung warming cannot cover
     # their request sizes). Off = first requests pay the compiles.
-    serve_warm_compile: bool = True
+    serve_warm_compile: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_SERVE_WARM_COMPILE", True, "serve_warm_compile"
+        )
+    )
     # Device-grant watchdog (`runtime.faults.device_grant`): when > 0,
     # the scheduler's device acquisition runs under a watchdog thread
     # and falls back to the CPU backend with a loud one-time warning if
@@ -562,7 +676,11 @@ class Config:
         )
     )
     # Debug mode: raise on NaN/Inf in any verb output (block + fetch named).
-    check_numerics: bool = False
+    check_numerics: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_CHECK_NUMERICS", False, "check_numerics"
+        )
+    )
     # Route verbs through the C++ PJRT host (`runtime.native_executor`)
     # when no explicit executor= is passed — the SURVEY §2.4 framing:
     # the native host is the libtensorflow-equivalent spine, not an

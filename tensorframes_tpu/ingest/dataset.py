@@ -209,7 +209,7 @@ class Dataset:
                 try:
                     e.tfs_shard_path = path
                 except Exception:
-                    pass
+                    pass  # __slots__ errors refuse stamps; e still raises
             raise
 
     def task_list(self) -> List[ChunkTask]:

@@ -1155,7 +1155,7 @@ def explain_analyze(plan, format: str = "text"):
         with _tele.span("explain_analyze.sync", kind="host_sync"):
             jax.block_until_ready(result)
     except Exception:
-        pass
+        pass  # host-resident result: nothing async left to drain
     wall_s = _time.perf_counter() - t0
     new = [s for s in _tele.spans() if s.span_id > sid0]
     data = _analyze_window(new, wall_s, _tele.spans_dropped() - dropped0)

@@ -29,6 +29,10 @@ __all__ = ["OpRule", "LowerCtx", "register", "get_rule", "GraphLoweringError", "
 class GraphLoweringError(ValueError):
     """Raised when a graph cannot be lowered to XLA."""
 
+    # a lowering failure is a property of the graph, not of the device:
+    # re-running the identical dispatch fails identically
+    tfs_fault_class = "deterministic"
+
 
 @dataclass
 class OpRule:

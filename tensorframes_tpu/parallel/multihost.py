@@ -55,7 +55,7 @@ def initialize_distributed(
         if getattr(_dist.global_state, "client", None) is not None:
             return  # jax.distributed.initialize already ran in this process
     except Exception:
-        pass
+        pass  # private jax internals moved: fall through to initialize
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

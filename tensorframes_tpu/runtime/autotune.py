@@ -953,7 +953,7 @@ def reset() -> None:
         for desc in _reg.endpoints():
             _reg.get(desc["name"]).batch_window_ms = None
     except Exception:
-        pass
+        pass  # serving never imported: no endpoint windows to clear
 
 
 def state() -> Dict:

@@ -114,6 +114,11 @@ class CheckpointError(RuntimeError):
     restarted from zero — pass ``resume="ignore"`` to opt into a fresh
     start."""
 
+    # retrying a corrupt/drifted/ineligible checkpoint re-reads the same
+    # bytes — surface it once; message text ("INTERNAL:..." in a quoted
+    # manifest field) must never pattern-match into the transient class
+    tfs_fault_class = "deterministic"
+
     def __init__(
         self,
         message: str,
@@ -688,7 +693,7 @@ class StreamCheckpointer:
             exc.tfs_checkpoint_path = self.store.path
             exc.tfs_checkpoint_watermark = self.watermark
         except Exception:
-            pass
+            pass  # __slots__ errors refuse stamps; the typed exit raises
 
     def finalize(self, ordinal: int, partials: List[Dict]) -> None:
         """Successful completion: commit the final state (watermark =

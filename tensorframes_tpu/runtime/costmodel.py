@@ -98,7 +98,7 @@ def device_peaks(device=None) -> Dict[str, Optional[float]]:
             device, "platform", None
         )
     except Exception:
-        pass
+        pass  # no live backend: peaks honestly read as unknown
     row = DEVICE_PEAKS.get(kind or "", {})
     return {
         "device_kind": kind,
@@ -250,7 +250,7 @@ def capture(key: Tuple, fn, args, lowered=None, phase: str = "xla") -> None:
             ent["capture_s"] += dt
             ent["phase"] = ent["phase"] or phase
     except Exception:
-        pass
+        pass  # the ledger degrades to unknown, never breaks a compile
 
 
 def note_exec(key: Tuple, args, out, verb: Optional[str] = None) -> None:
@@ -288,7 +288,7 @@ def note_exec(key: Tuple, args, out, verb: Optional[str] = None) -> None:
                         "bytes": footprint, "program": fp, "rows": rows,
                     }
     except Exception:
-        pass
+        pass  # exec accounting must never break the dispatch it counts
 
 
 def program_costs() -> Dict[str, Dict]:
@@ -468,7 +468,7 @@ def memory_overview() -> List[Dict]:
             except Exception:
                 continue
     except Exception:
-        pass
+        pass  # backend gone mid-probe: report the rows gathered so far
     return [rows[k] for k in sorted(rows)]
 
 

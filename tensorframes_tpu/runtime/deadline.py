@@ -368,7 +368,7 @@ def _mean_verb_seconds() -> Optional[float]:
         if tot_n:
             return tot_s / tot_n
     except Exception:
-        pass
+        pass  # no latency history: retry_after uses the default hint
     return None
 
 
@@ -446,7 +446,7 @@ class AdmissionController:
 
             _faults.note_shed()
         except Exception:
-            pass
+            pass  # shed accounting must never mask the typed error
         return OverloadError(
             f"{verb}: admission control shed this call — "
             f"{self.in_flight} verb(s) in flight (limit {limit}), "
@@ -511,7 +511,7 @@ class AdmissionController:
 
                 _tele.counter_inc("admission_wait_seconds", waited)
             except Exception:
-                pass
+                pass  # wait accounting must never fail an admitted verb
 
         released = [False]
 

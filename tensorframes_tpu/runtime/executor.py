@@ -307,7 +307,7 @@ class Executor:
                     out[key] = int(sizer())
                     continue
                 except Exception:
-                    pass
+                    pass  # a broken sizer reads as 1, never breaks stats
             out[key] = 1
         return out
 

@@ -311,7 +311,7 @@ def record_oom(
 
         _tele.counter_inc("oom_forensics", 1.0, verb=str(verb))
     except Exception:
-        pass
+        pass  # forensics must not worsen the failure it documents
 
 
 def forensics_snapshot() -> list:
@@ -430,7 +430,7 @@ class FaultScope:
                         e.tfs_blocks_issued = p["issued"]
                         e.tfs_blocks_unissued = p["unissued"]
                     except Exception:
-                        pass
+                        pass  # __slots__ errors refuse stamps; e raises
             return e
 
         attempt = 0

@@ -140,7 +140,7 @@ def default_plugin_path() -> Optional[str]:
             if hits:
                 return hits[0]
     except Exception:
-        pass
+        pass  # unreadable plugin root: fall back to the repo CPU plugin
     return cpu_plugin_path()
 
 

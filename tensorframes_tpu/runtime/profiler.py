@@ -573,7 +573,7 @@ def _capture_meta(note: Optional[str]) -> Dict:
             getattr(devs[0], "platform", None)
         )
     except Exception:
-        pass
+        pass  # no live backend: the profile meta omits device fields
     return meta
 
 

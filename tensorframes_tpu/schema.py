@@ -42,6 +42,9 @@ Unknown = None
 class UnsupportedTypeError(TypeError):
     """Raised when a dtype outside the supported scalar set is used."""
 
+    # a schema/dtype rejection never succeeds on retry
+    tfs_fault_class = "deterministic"
+
 
 class ScalarType(enum.Enum):
     """Supported cell scalar types.

@@ -422,7 +422,7 @@ class MicroBatcher:
                 try:
                     r.future.set_exception(e)
                 except Exception:
-                    pass
+                    pass  # cancelled waiter: the error has no audience
 
 
 class _nullcontext:

@@ -32,6 +32,12 @@ class ServingError(RuntimeError):
     """Non-typed serving failure: carries ``status`` and the decoded
     ``body`` dict (or raw text) the server returned."""
 
+    # the typed retryable cases already re-raise as OverloadError /
+    # DeadlineExceeded; what is left (4xx/5xx bodies) does not improve
+    # on a blind re-send — and a relayed server traceback containing a
+    # status token must never pattern-match into the transient class
+    tfs_fault_class = "deterministic"
+
     def __init__(self, message: str, status: int, body):
         super().__init__(message)
         self.status = int(status)

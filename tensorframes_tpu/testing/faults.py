@@ -122,7 +122,7 @@ def _args_device_label(args) -> Optional[str]:
                         f"{getattr(d, 'id', '?')}"
                     )
     except Exception:
-        pass
+        pass  # foreign array types: the plan records no device label
     return None
 
 

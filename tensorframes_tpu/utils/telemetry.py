@@ -508,7 +508,7 @@ def _buckets_for(name: str) -> Tuple[float, ...]:
                 if b and all(x < y for x, y in zip(b, b[1:])):
                     return b
     except Exception:
-        pass
+        pass  # malformed override falls back to the family default
     return _DEFAULT_BUCKETS[fam]
 
 
@@ -611,7 +611,7 @@ class MetricsRegistry:
                 for lv, v in fn().items():
                     out[(name, ((label, str(lv)),))] = float(v)
             except Exception:
-                pass
+                pass  # a dead gauge family must never break an export
         return out
 
     # -- histograms -----------------------------------------------------
@@ -854,7 +854,7 @@ def _json_safe(v):
         try:
             return item()
         except Exception:
-            pass
+            pass  # non-scalar .item(): fall through to str()
     return str(v)
 
 
@@ -965,6 +965,15 @@ _PROM_HELP: Dict[str, str] = {
     "ingest_stage_wait_seconds": "Ingest stage starved time",
     "verb_seconds": "Verb call latency",
     "compile_seconds": "Compile time by program and phase",
+    "serve_requests": "Serving requests accepted per endpoint",
+    "serve_batches": "Coalesced serving dispatches per endpoint",
+    "serve_shed": "Serving requests shed at a full lane per endpoint",
+    "serve_batch_rows": "Rows per coalesced serving dispatch",
+    "serve_batch_fill": "Requests coalesced into one serving dispatch",
+    "serve_queue_seconds": "Request wait in the batching lane",
+    "serve_pending": "Serving requests queued across all lanes",
+    "serve_warm_rungs": "Bucket rungs warm-compiled per endpoint",
+    "serve_endpoints_registered": "Serving endpoints registered",
     "bucket_fill": "Valid-row fraction of each bucketed dispatch by verb",
     "costmodel_residual": (
         "Span-achieved vs cost-model-predicted time ratio per program"

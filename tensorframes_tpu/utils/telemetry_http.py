@@ -101,7 +101,7 @@ def _json_default(o):
         try:
             return item()
         except Exception:
-            pass
+            pass  # non-scalar .item(): fall through to str()
     if isinstance(o, (set, frozenset, tuple)):
         return list(o)
     return str(o)

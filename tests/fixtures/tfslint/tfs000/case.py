@@ -1,0 +1,18 @@
+"""TFS000 fixture: a suppression WITHOUT a reason disarms nothing and
+is itself a finding. Never imported.
+
+A marker quoted inside a string is NOT a suppression — this docstring's
+own example (`# tfslint: disable=TFS001`) must not register, or merely
+documenting the syntax would trip the checker.
+"""
+
+
+def reasonless_suppression(fn):
+    try:
+        fn()
+    except Exception:
+        pass  # tfslint: disable=TFS005
+
+
+def unknown_code_suppression():
+    return 1  # tfslint: disable=TFS999 a typo'd check id is a finding, not a silent no-op

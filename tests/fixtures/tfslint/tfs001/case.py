@@ -1,0 +1,59 @@
+"""TFS001 fixture: blocking calls under a lock — positive, suppressed,
+and clean variants. Never imported; parsed by the linter only."""
+
+import queue
+import threading
+import time
+
+_lock = threading.Lock()
+_cond = threading.Condition(_lock)
+_q = queue.Queue()
+
+
+def positive_sleep_under_lock():
+    with _lock:
+        time.sleep(0.1)  # expected finding: sleep while holding _lock
+
+
+def positive_join_under_lock(t):
+    with _lock:
+        t.join()  # expected finding: thread join while holding _lock
+
+
+def positive_untimed_queue_get():
+    with _lock:
+        return _q.get()  # expected finding: untimed get under _lock
+
+
+def positive_join_none_under_lock(t):
+    with _lock:
+        t.join(None)  # expected finding: join(None) is the unbounded join
+
+
+def suppressed_sleep_under_lock():
+    with _lock:
+        time.sleep(0.1)  # tfslint: disable=TFS001 fixture: proves suppression syntax disarms the finding
+
+
+def clean_sleep_outside_lock():
+    with _lock:
+        x = 1
+    time.sleep(0.0)
+    return x
+
+
+def clean_condition_wait():
+    # the Condition protocol REQUIRES holding the condition; wait()
+    # releases it — the one allowed "blocking" call under a lock
+    with _cond:
+        _cond.wait(0.1)
+
+
+def clean_timed_queue_get():
+    with _lock:
+        return _q.get(timeout=0.1)
+
+
+def clean_str_join(parts):
+    with _lock:
+        return ",".join(parts)

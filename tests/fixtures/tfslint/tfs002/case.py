@@ -1,0 +1,47 @@
+"""TFS002 fixture: metric names vs the _PROM_HELP table, and label-key
+consistency. Never imported; parsed by the linter only."""
+
+
+def counter_inc(name, value=1.0, **labels):
+    pass  # fixture stand-in for the registry helper
+
+
+def histogram_observe(name, value, **labels):
+    pass  # fixture stand-in for the registry helper
+
+
+_PROM_HELP = {
+    "good_metric": "A metric with curated help text",
+    "labeled_metric": "A metric whose label keys must agree",
+}
+
+
+def clean_site():
+    counter_inc("good_metric", 1.0)
+
+
+def clean_value_keyword_site():
+    # the declared value= parameter is not a label: no drift vs the
+    # positional spelling above
+    counter_inc("good_metric", value=2.0)
+
+
+def positive_missing_help():
+    counter_inc("bad_metric", 1.0)  # expected finding: no _PROM_HELP
+
+
+def suppressed_missing_help():
+    counter_inc("other_bad_metric", 1.0)  # tfslint: disable=TFS002 fixture: proves suppression syntax disarms the finding
+
+
+def label_reference_site():
+    histogram_observe("labeled_metric", 1.0, verb="map_blocks")
+
+
+def positive_label_drift():
+    # expected finding: stage= here vs verb= at the reference site
+    histogram_observe("labeled_metric", 1.0, stage="decode")
+
+
+def clean_dynamic_name(verb):
+    counter_inc(f"{verb}.calls")  # dynamic names are out of static reach

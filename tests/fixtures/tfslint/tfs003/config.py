@@ -1,0 +1,51 @@
+"""TFS003 fixture: Config-knob env/docs parity. Never imported; the
+`_env_*` helpers only need to exist syntactically."""
+
+import dataclasses
+from typing import Optional
+
+
+def _env_int(var, default, field, minimum=None):
+    return default
+
+
+def _env_bool(var, default, field):
+    return default
+
+
+@dataclasses.dataclass
+class Config:
+    # clean: env-seeded with the canonical var + field names, documented
+    good_knob: int = dataclasses.field(
+        default_factory=lambda: _env_int("TFS_GOOD_KNOB", 1, "good_knob")
+    )
+    # expected finding: scalar knob with no env override
+    no_env_knob: int = 2
+    # expected finding: env var name drifted from the canonical form
+    drifted_knob: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_WRONG_NAME", False, "drifted_knob"
+        )
+    )
+    # expected finding: keyword spelling must not disarm the drift
+    # checks — the field= kwarg records the WRONG knob in the ledger
+    kw_drifted_knob: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            var="TFS_KW_DRIFTED_KNOB", default=6, field="good_knob"
+        )
+    )
+    # expected finding: helper records the WRONG field in the pin ledger
+    misfielded_knob: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_MISFIELDED_KNOB", 3, "good_knob"
+        )
+    )
+    # expected finding: documented nowhere in the docs file
+    undocumented_knob: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_UNDOCUMENTED_KNOB", 4, "undocumented_knob"
+        )
+    )
+    suppressed_knob: int = 5  # tfslint: disable=TFS003 fixture: proves suppression syntax disarms the finding
+    # exempt from the env requirement: not a scalar annotation
+    optional_knob: Optional[int] = None

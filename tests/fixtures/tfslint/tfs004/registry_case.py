@@ -1,0 +1,12 @@
+"""TFS004 fixture (registries): module-level mutable state with no
+reset hook. Never imported."""
+
+_registry = {}  # expected finding: mutable registry, no reset hook
+
+_suppressed_registry = {}  # tfslint: disable=TFS004 fixture: proves suppression syntax disarms the finding
+
+UPPER_CONSTANT = {"a": 1}  # clean: UPPERCASE names are constants
+
+
+def add(key, value):
+    _registry[key] = value

@@ -1,0 +1,12 @@
+"""TFS004 fixture (registries, clean): a reset hook disarms the
+module-state finding. Never imported."""
+
+_registry = {}
+
+
+def add(key, value):
+    _registry[key] = value
+
+
+def reset_state():
+    _registry.clear()

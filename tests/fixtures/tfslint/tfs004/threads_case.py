@@ -1,0 +1,22 @@
+"""TFS004 fixture (threads): daemon/teardown discipline. This module
+deliberately defines NO reset/shutdown teardown. Never imported."""
+
+import threading
+
+
+def positive_non_daemon_thread(fn):
+    t = threading.Thread(target=fn)  # expected finding: not daemon=True
+    t.start()
+    return t
+
+
+def suppressed_non_daemon_thread(fn):
+    t = threading.Thread(target=fn)  # tfslint: disable=TFS004 fixture: proves suppression syntax disarms the finding
+    t.start()
+    return t
+
+
+def clean_daemon_thread(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,17 @@
+"""TFS004 fixture (threads, clean): a non-daemon thread is fine when
+the module defines a joining teardown. Never imported."""
+
+import threading
+
+_worker = None
+
+
+def start(fn):
+    global _worker
+    _worker = threading.Thread(target=fn)  # joined by shutdown() below
+    _worker.start()
+
+
+def shutdown():
+    if _worker is not None:
+        _worker.join(timeout=5.0)

@@ -1,0 +1,59 @@
+"""TFS005 fixture: fault-typing declarations + silent swallows.
+Never imported; parsed by the linter only."""
+
+
+class PositiveError(RuntimeError):
+    """Expected finding: no tfs_fault_class declaration."""
+
+
+class SuppressedError(RuntimeError):  # tfslint: disable=TFS005 fixture: proves suppression syntax disarms the finding
+    pass
+
+
+class CleanClassLevelError(RuntimeError):
+    tfs_fault_class = "deterministic"
+
+
+class CleanInstanceLevelError(RuntimeError):
+    def __init__(self, fault_class):
+        super().__init__("boom")
+        self.tfs_fault_class = fault_class
+
+
+class CleanDerivedError(CleanClassLevelError):
+    """Inherits the declaration from an in-package error base."""
+
+
+def positive_silent_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+def positive_bare_except_swallow(fn):
+    try:
+        fn()
+    except:
+        pass
+
+
+def suppressed_silent_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass  # tfslint: disable=TFS005 fixture: proves suppression syntax disarms the finding
+
+
+def clean_commented_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass  # fixture: the why-comment satisfies the check
+
+
+def clean_non_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        raise RuntimeError("wrapped") from None

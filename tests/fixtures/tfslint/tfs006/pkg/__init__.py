@@ -1,0 +1,11 @@
+"""TFS006 fixture package: __all__ vs the docs file. Never imported."""
+
+documented_name = 1
+undocumented_name = 2
+suppressed_name = 3
+
+__all__ = [
+    "documented_name",
+    "undocumented_name",  # expected finding: absent from the docs file
+    "suppressed_name",  # tfslint: disable=TFS006 fixture: proves suppression syntax disarms the finding
+]

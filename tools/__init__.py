@@ -1,0 +1,6 @@
+"""Repo tooling (`tools.tfslint`, bench compare, report renderers).
+
+A real package (not just loose scripts) so `python -m tools.tfslint`
+works from a bare checkout; the standalone scripts (`bench_compare.py`,
+`profile_report.py`, `endpoint_smoke.py`) keep running as plain files.
+"""

@@ -1,0 +1,31 @@
+"""tfslint: AST-based invariant checks for this repo's own conventions.
+
+Generic linters enforce style; this one enforces the *load-bearing*
+invariants the review history shows get violated mechanically —
+blocking calls under module locks, metric names missing their
+`_PROM_HELP` exposition entry, config knobs drifting out of env/docs
+parity, threads and mutable module registries escaping the conftest
+reset discipline, untyped exception classes crossing the fault
+classifier, and public exports without an API.md row. See
+`docs/ARCHITECTURE.md` "Static invariants" for the one-paragraph
+history of each check.
+
+Usage::
+
+    python -m tools.tfslint tensorframes_tpu/            # human output
+    python -m tools.tfslint tensorframes_tpu/ --format json
+    make lint
+
+Findings are suppressed inline, one line at a time, with a written
+reason (the reason is REQUIRED — a bare suppression is itself a
+finding)::
+
+    time.sleep(0.1)  # tfslint: disable=TFS001 <why this is safe here>
+
+Exit status: 0 = clean, 1 = unsuppressed findings, 2 = usage error.
+"""
+
+from .core import Finding, Project, run_checks  # noqa: F401
+from .checks import ALL_CHECKS  # noqa: F401
+
+__version__ = "1.0"

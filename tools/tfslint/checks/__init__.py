@@ -1,0 +1,20 @@
+"""The tfslint check registry: one module per check, one class each."""
+
+from .tfs001_locks import LockDisciplineCheck
+from .tfs002_telemetry import TelemetryRegistryCheck
+from .tfs003_config import ConfigKnobCheck
+from .tfs004_threads import ThreadResetCheck
+from .tfs005_faults import FaultTypingCheck
+from .tfs006_exports import ExportDocsCheck
+
+#: instantiation order = report grouping order
+ALL_CHECKS = (
+    LockDisciplineCheck(),
+    TelemetryRegistryCheck(),
+    ConfigKnobCheck(),
+    ThreadResetCheck(),
+    FaultTypingCheck(),
+    ExportDocsCheck(),
+)
+
+CHECKS_BY_CODE = {c.code: c for c in ALL_CHECKS}

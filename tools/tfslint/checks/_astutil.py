@@ -1,0 +1,36 @@
+"""Tiny shared AST helpers for the tfslint checks."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+
+def tail_name(expr: ast.AST) -> str:
+    """The last identifier of an expression: ``Name.id``,
+    ``Attribute.attr``, or the callee's tail for a ``Call`` — what the
+    lock/helper name heuristics match against."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        return tail_name(expr.func)
+    return ""
+
+
+def const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def keyword_value(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def is_true_const(node: Optional[ast.AST]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True

@@ -1,0 +1,167 @@
+"""TFS001: no blocking call lexically inside a ``with <lock>:`` body.
+
+The bug class PR 12 fixed twice: a thread join / event wait / sleep /
+untimed queue get / subprocess call performed while holding a module or
+instance lock stalls every other lock user — and when the blocked-on
+thread itself needs the lock, it deadlocks (the autotune ``stop()``
+hold-and-join). The check is lexical: anything that *looks like* a lock
+(a ``with`` context whose name contains ``lock``/``mutex``/``cond``)
+opens a held region; nested ``def``/``lambda`` bodies leave it (they
+run later, not under the lock).
+
+Allowed by design: ``<cond>.wait(...)`` where the receiver is itself
+the innermost held context — the `threading.Condition` protocol
+*requires* holding the condition and releases it during the wait.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Project
+from ._astutil import tail_name
+
+CODE = "TFS001"
+NAME = "lock-discipline"
+
+_LOCKISH = ("lock", "mutex", "cond")
+_SUBPROCESS_CALLS = {
+    "run", "call", "check_call", "check_output", "Popen",
+    "getoutput", "getstatusoutput",
+}
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    return any(t in tail_name(expr).lower() for t in _LOCKISH)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod):
+        self.mod = mod
+        self.held: List[str] = []  # unparsed lock exprs, outermost first
+        self.findings: List[Finding] = []
+        self.time_sleep_names = set()  # `from time import sleep [as x]`
+
+    # -- scope handling -------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        locks = [
+            ast.unparse(i.context_expr)
+            for i in node.items
+            if _is_lockish(i.context_expr)
+        ]
+        self.held.extend(locks)
+        self.generic_visit(node)
+        del self.held[len(self.held) - len(locks):len(self.held)]
+
+    visit_AsyncWith = visit_With
+
+    def _fresh_scope(self, node) -> None:
+        held, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = held
+
+    visit_FunctionDef = _fresh_scope
+    visit_AsyncFunctionDef = _fresh_scope
+    visit_Lambda = _fresh_scope
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    self.time_sleep_names.add(a.asname or a.name)
+        self.generic_visit(node)
+
+    # -- the blocking-call table ---------------------------------------
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(
+            Finding(
+                CODE, self.mod.rel, node.lineno,
+                f"{what} while holding lock "
+                f"`{self.held[-1]}` — blocking under a lock stalls every "
+                "other lock user (move the blocking call outside the "
+                "critical section)",
+            )
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            self._check_blocking(node)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in self.time_sleep_names:
+                self._flag(node, "time.sleep()")
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        attr = func.attr
+        recv = func.value
+        recv_name = tail_name(recv)
+        has_kw = {kw.arg for kw in node.keywords}
+        if attr == "sleep" and recv_name in ("time", "_time"):
+            self._flag(node, "time.sleep()")
+        elif attr == "sleep_interruptible":
+            self._flag(node, "deadline.sleep_interruptible()")
+        elif attr == "wait":
+            # Condition protocol: waiting on the innermost held context
+            # itself is the one CORRECT way to block "under" a lock —
+            # Condition.wait releases it for the duration
+            if not self.held or ast.unparse(recv) != self.held[-1]:
+                self._flag(node, f"`{ast.unparse(recv)}.wait()`")
+        elif attr == "join":
+            # thread join: zero positional args, a numeric timeout, or
+            # the explicitly-unbounded join(None) spelling. (str.join
+            # always takes one non-numeric iterable argument.)
+            blocking0 = (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and (
+                    node.args[0].value is None
+                    or isinstance(node.args[0].value, (int, float))
+                )
+            )
+            if not node.args or blocking0:
+                self._flag(node, f"`{ast.unparse(recv)}.join()`")
+        elif attr == "get":
+            # untimed queue get: no positional args, no timeout=, and a
+            # queue-named receiver — zero-arg `.get()` is also the
+            # config/registry accessor idiom (`_config.get()`), so the
+            # receiver name carries the discrimination
+            queueish = (
+                recv_name.lower() == "q"
+                or "queue" in recv_name.lower()
+                or recv_name.lower().endswith("_q")
+            )
+            if queueish and not node.args and "timeout" not in has_kw:
+                self._flag(node, f"untimed `{ast.unparse(recv)}.get()`")
+        elif attr == "result":
+            if not node.args and "timeout" not in has_kw:
+                self._flag(
+                    node, f"untimed `{ast.unparse(recv)}.result()`"
+                )
+        elif attr == "communicate":
+            self._flag(node, f"`{ast.unparse(recv)}.communicate()`")
+        elif attr in _SUBPROCESS_CALLS and recv_name == "subprocess":
+            self._flag(node, f"subprocess.{attr}()")
+        elif attr == "system" and recv_name == "os":
+            self._flag(node, "os.system()")
+
+
+class LockDisciplineCheck:
+    code = CODE
+    name = NAME
+    description = (
+        "no Thread.join / Event.wait / time.sleep / untimed queue.get / "
+        "subprocess call lexically inside a `with <lock>:` body"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            v = _Visitor(mod)
+            v.visit(mod.tree)
+            out.extend(v.findings)
+        return out

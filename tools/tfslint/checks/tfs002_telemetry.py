@@ -1,0 +1,194 @@
+"""TFS002: telemetry-registry parity for literal metric names.
+
+Two invariants over every literal metric name passed to the registry
+helpers (`counter_inc` / `histogram_observe` / `gauge_set` /
+`gauge_register` / `gauge_register_multi`, however imported):
+
+1. the name has a curated ``_PROM_HELP`` entry — the exposition
+   otherwise falls back to a generic ``# HELP`` line, and several
+   Prometheus toolchains hard-fail a family without real help text
+   (the bug class: `serve_batch_rows`/`serve_batch_fill`/
+   `serve_queue_seconds` shipped helpless in PR 10);
+2. the label-KEY set for one metric name is identical across call
+   sites — `m{verb=...}` at one site and `m{stage=...}` at another is
+   two incompatible series under one name, which scrapes fine and then
+   breaks every aggregation over it.
+
+Dynamic names (f-strings, variables — the legacy ``<verb>.calls``
+family) are out of static reach and skipped; a ``**labels`` splat of a
+non-literal dict excludes that site from the label-consistency vote.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, Project
+from ._astutil import const_str
+
+CODE = "TFS002"
+NAME = "telemetry-registry"
+
+_HELPERS = {
+    "counter_inc",
+    "histogram_observe",
+    "gauge_set",
+    "gauge_register",
+    "gauge_register_multi",
+}
+#: helpers whose kwargs are metric labels (the consistency vote)
+_LABELED = {"counter_inc", "histogram_observe", "gauge_set"}
+
+
+class _Site:
+    __slots__ = ("mod", "line", "helper", "metric", "labels")
+
+    def __init__(self, mod, line, helper, metric, labels):
+        self.mod = mod
+        self.line = line
+        self.helper = helper
+        self.metric = metric
+        self.labels = labels  # frozenset | None (None = not comparable)
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, mod):
+        self.mod = mod
+        self.aliases: Dict[str, str] = {}  # local name -> helper name
+        self.help_keys: Optional[Set[str]] = None
+        self.help_line = 0
+        self.sites: List[_Site] = []
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name in _HELPERS:
+                self.aliases[a.asname or a.name] = a.name
+        self.generic_visit(node)
+
+    def _record_help(self, target, value, lineno) -> None:
+        if isinstance(target, ast.Name) and target.id == "_PROM_HELP":
+            if isinstance(value, ast.Dict):
+                self.help_keys = {
+                    k.value
+                    for k in value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+                self.help_line = lineno
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1:
+            self._record_help(node.targets[0], node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_help(node.target, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        helper = None
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _HELPERS:
+            helper = func.attr
+        elif isinstance(func, ast.Name) and func.id in _HELPERS:
+            helper = func.id
+        elif isinstance(func, ast.Name) and func.id in self.aliases:
+            helper = self.aliases[func.id]
+        if helper is not None:
+            metric = const_str(node.args[0]) if node.args else None
+            if metric is not None:
+                self.sites.append(
+                    _Site(
+                        self.mod, node.lineno, helper, metric,
+                        self._labels(node, helper),
+                    )
+                )
+        self.generic_visit(node)
+
+    def _labels(self, node: ast.Call, helper: str):
+        if helper == "gauge_register_multi":
+            # (name, label, fn): the one label key is the second arg
+            lab = const_str(node.args[1]) if len(node.args) > 1 else None
+            return frozenset((lab,)) if lab else None
+        if helper not in _LABELED:
+            return frozenset()
+        keys: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg == "value":
+                continue  # the declared (name, value=1.0, **labels)
+                # parameter — a legal keyword spelling, never a label
+            if kw.arg is not None:
+                keys.add(kw.arg)
+            else:  # **splat: literal dict keys count, else incomparable
+                if isinstance(kw.value, ast.Dict) and all(
+                    isinstance(k, ast.Constant) for k in kw.value.keys
+                ):
+                    keys.update(k.value for k in kw.value.keys)
+                else:
+                    return None
+        return frozenset(keys)
+
+
+class TelemetryRegistryCheck:
+    code = CODE
+    name = NAME
+    description = (
+        "every literal metric name has a _PROM_HELP entry and a "
+        "consistent label-key set across call sites"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        help_keys: Optional[Set[str]] = None
+        sites: List[_Site] = []
+        for mod in project.modules:
+            v = _Visitor(mod)
+            v.visit(mod.tree)
+            if v.help_keys is not None:
+                help_keys = (
+                    v.help_keys
+                    if help_keys is None
+                    else help_keys | v.help_keys
+                )
+            sites.extend(v.sites)
+
+        out: List[Finding] = []
+        if not sites:
+            return out
+        known = help_keys or set()
+        for s in sites:
+            if s.metric not in known:
+                out.append(
+                    Finding(
+                        CODE, s.mod.rel, s.line,
+                        f"metric `{s.metric}` has no _PROM_HELP entry — "
+                        "/metrics exposes it with a generic # HELP line "
+                        "(add curated help text to the _PROM_HELP table)",
+                    )
+                )
+
+        # label-key consistency: first observed set per name is the
+        # reference; later deviating sites are flagged
+        ref: Dict[str, Tuple[frozenset, _Site]] = {}
+        for s in sites:
+            if s.labels is None:
+                continue
+            if s.helper == "gauge_register":
+                continue  # registered gauges are unlabeled by contract
+            if s.metric not in ref:
+                ref[s.metric] = (s.labels, s)
+                continue
+            want, first = ref[s.metric]
+            if s.labels != want:
+                out.append(
+                    Finding(
+                        CODE, s.mod.rel, s.line,
+                        f"metric `{s.metric}` emitted with label keys "
+                        f"{sorted(s.labels) or '(none)'} here but "
+                        f"{sorted(want) or '(none)'} at "
+                        f"{first.mod.rel}:{first.line} — one name must "
+                        "carry one label-key set",
+                    )
+                )
+        return out

@@ -1,0 +1,142 @@
+"""TFS003: config-knob parity — env override + docs row per knob.
+
+Every *scalar* knob on the `Config` dataclass (annotation exactly
+``bool``/``int``/``float``/``str``) must:
+
+1. seed from a ``TFS_<KNOB>`` env var through the malformed-falls-back
+   ``_env_*`` helpers (``default_factory=lambda: _env_int("TFS_X", ...,
+   "x")``) — a typo'd value must never break the package import, and a
+   knob without an env override cannot be deployed without a code
+   change (the drift PR 12's satellite (a) closed for three knobs;
+   this check closes it structurally);
+2. pass its OWN field name as the helper's ``field`` argument (that is
+   what records a well-formed env value as an operator pin) and use
+   the canonical var name ``TFS_`` + upper-cased field name;
+3. appear by name in the docs file (`docs/API.md`) — an undocumented
+   knob is an unusable knob.
+
+Non-scalar knobs (``Optional[...]`` defaults, mesh objects, dicts) are
+exempt from (1)–(2) but still need the docs row.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..core import Finding, Project
+from ._astutil import const_str, keyword_value
+
+CODE = "TFS003"
+NAME = "config-knob-parity"
+
+_SCALARS = {"bool", "int", "float", "str"}
+
+
+def _env_call(default: Optional[ast.AST]) -> Tuple[bool, str, str]:
+    """Inspect a field default: returns (has_env, env_var, field_arg).
+    Recognizes ``field(default_factory=lambda: _env_x("TFS_...", d,
+    "name", ...))`` and ``field(default_factory=_env_special)``."""
+    if not (
+        isinstance(default, ast.Call)
+        and isinstance(default.func, (ast.Name, ast.Attribute))
+    ):
+        return False, "", ""
+    factory = keyword_value(default, "default_factory")
+    if factory is None:
+        return False, "", ""
+    if isinstance(factory, ast.Name) and factory.id.startswith("_env"):
+        return True, "", ""  # dedicated helper (histogram_buckets style)
+    if isinstance(factory, ast.Lambda):
+        body = factory.body
+        if (
+            isinstance(body, ast.Call)
+            and isinstance(body.func, ast.Name)
+            and body.func.id.startswith("_env")
+        ):
+            # positional (var, default, field) with a keyword-spelling
+            # fallback — kwargs must not disarm the drift checks
+            var = const_str(body.args[0]) if body.args else None
+            if var is None:
+                var = const_str(keyword_value(body, "var"))
+            fieldarg = (
+                const_str(body.args[2]) if len(body.args) > 2 else None
+            )
+            if fieldarg is None:
+                fieldarg = const_str(keyword_value(body, "field"))
+            return True, var or "", fieldarg or ""
+    return False, "", ""
+
+
+class ConfigKnobCheck:
+    code = CODE
+    name = NAME
+    description = (
+        "every scalar Config knob has a TFS_* env override through the "
+        "malformed-falls-back helpers and a docs/API.md row"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.ClassDef)
+                    and node.name == "Config"
+                ):
+                    out.extend(self._check_config(project, mod, node))
+        return out
+
+    def _check_config(self, project, mod, cls) -> List[Finding]:
+        out: List[Finding] = []
+        for stmt in cls.body:
+            if not (
+                isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+            ):
+                continue
+            knob = stmt.target.id
+            ann = stmt.annotation
+            scalar = isinstance(ann, ast.Name) and ann.id in _SCALARS
+            has_env, var, fieldarg = _env_call(stmt.value)
+            if scalar and not has_env:
+                out.append(
+                    Finding(
+                        CODE, mod.rel, stmt.lineno,
+                        f"config knob `{knob}` has no env override — "
+                        f"seed it from TFS_{knob.upper()} via the "
+                        "malformed-falls-back _env_* helpers "
+                        "(default_factory) so it deploys without a "
+                        "code change",
+                    )
+                )
+            if has_env and var and var != f"TFS_{knob.upper()}":
+                out.append(
+                    Finding(
+                        CODE, mod.rel, stmt.lineno,
+                        f"config knob `{knob}` reads env var `{var}` — "
+                        f"the canonical name is TFS_{knob.upper()} "
+                        "(env/knob naming drift)",
+                    )
+                )
+            if has_env and fieldarg and fieldarg != knob:
+                out.append(
+                    Finding(
+                        CODE, mod.rel, stmt.lineno,
+                        f"config knob `{knob}` passes field name "
+                        f"`{fieldarg}` to its _env_* helper — the pin "
+                        "ledger would record the wrong knob",
+                    )
+                )
+            if project.docs_text is not None and not project.docs_has_word(
+                knob
+            ):
+                out.append(
+                    Finding(
+                        CODE, mod.rel, stmt.lineno,
+                        f"config knob `{knob}` has no row in "
+                        f"{project.docs_path} — an undocumented knob "
+                        "is an unusable knob",
+                    )
+                )
+        return out

@@ -1,0 +1,145 @@
+"""TFS004: thread & module-state hygiene against the reset discipline.
+
+Two invariants from the PR 13/14 deflake history (straggler threads and
+leaked module state charging counters to the NEXT test's run):
+
+1. every ``threading.Thread(...)`` construction either passes
+   ``daemon=True`` at the call site or lives in a module that defines a
+   joining teardown (a top-level function or method named ``reset*`` /
+   ``shutdown`` / ``stop`` / ``close`` / ``drain`` whose body joins a
+   thread) — a non-daemon thread with no teardown path outlives the
+   test (and the process exit) that spawned it;
+2. every module-level *mutable registry* (a non-UPPERCASE name bound to
+   a dict/list/set/deque literal or constructor at module scope) lives
+   in a module exposing a ``reset*``-style hook the conftest autouse
+   fixture can call — unresettable module state is exactly what bled
+   one test's accounting into another before the reset discipline.
+
+UPPERCASE names are treated as constants (never reassigned state) and
+exempt; registries held in custom classes are out of static reach.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from ..core import Finding, Project
+from ._astutil import is_true_const, keyword_value
+
+CODE = "TFS004"
+NAME = "thread-reset-hygiene"
+
+_MUTABLE_CTORS = {
+    "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+    "Counter",
+}
+
+
+def _is_reset_name(name: str) -> bool:
+    return name.startswith("reset") or name in (
+        "shutdown", "stop", "close", "drain", "clear",
+    )
+
+
+def _module_has_reset(tree: ast.Module) -> bool:
+    return any(
+        isinstance(n, ast.FunctionDef) and _is_reset_name(n.name)
+        for n in tree.body
+    )
+
+
+def _module_has_joining_teardown(tree: ast.Module) -> bool:
+    """A reset-named function or method anywhere in the module whose
+    body contains a ``.join(...)`` call."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and _is_reset_name(node.name):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "join"
+                ):
+                    return True
+    return False
+
+
+def _thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return True
+    if isinstance(f, ast.Name) and f.id == "Thread":
+        return True
+    return False
+
+
+def _mutable_binding(stmt: ast.stmt) -> Optional[Tuple[str, int]]:
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        tgt, value = stmt.targets[0], stmt.value
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        tgt, value = stmt.target, stmt.value
+    else:
+        return None
+    if not isinstance(tgt, ast.Name):
+        return None
+    name = tgt.id
+    if name.isupper() or name == "__all__":
+        return None  # constants by convention
+    mutable = isinstance(value, (ast.Dict, ast.List, ast.Set))
+    if isinstance(value, ast.Call):
+        f = value.func
+        ctor = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute)
+            else ""
+        )
+        mutable = ctor in _MUTABLE_CTORS or ctor.lstrip("_") in (
+            _MUTABLE_CTORS
+        )
+    return (name, stmt.lineno) if mutable else None
+
+
+class ThreadResetCheck:
+    code = CODE
+    name = NAME
+    description = (
+        "threads are daemon=True or joined by a module teardown; "
+        "module-level mutable registries expose a reset hook"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            has_join_teardown = _module_has_joining_teardown(mod.tree)
+            has_reset = _module_has_reset(mod.tree)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and _thread_ctor(node):
+                    if is_true_const(keyword_value(node, "daemon")):
+                        continue
+                    if has_join_teardown:
+                        continue
+                    out.append(
+                        Finding(
+                            CODE, mod.rel, node.lineno,
+                            "threading.Thread(...) without daemon=True "
+                            "in a module with no joining reset/shutdown "
+                            "teardown — the thread outlives the test "
+                            "(and the process exit) that spawned it",
+                        )
+                    )
+            for stmt in mod.tree.body:
+                binding = _mutable_binding(stmt)
+                if binding is not None and not has_reset:
+                    name, lineno = binding
+                    out.append(
+                        Finding(
+                            CODE, mod.rel, lineno,
+                            f"module-level mutable registry `{name}` in "
+                            "a module with no reset hook — state "
+                            "accumulated here leaks across the conftest "
+                            "reset discipline (add a reset()/clear "
+                            "hook, or suppress if it is a pure "
+                            "content-keyed memo)",
+                        )
+                    )
+        return out

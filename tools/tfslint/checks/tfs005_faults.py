@@ -1,0 +1,141 @@
+"""TFS005: fault-typing discipline for exception classes and swallows.
+
+`runtime.faults.classify` routes every dispatch exception: transient →
+retry, resource → split, deterministic → surface once. It honors an
+explicit ``tfs_fault_class`` attribute FIRST, then falls back to
+message pattern-matching on runtime-ish types. Two invariants:
+
+1. every exception class this package defines directly on a *builtin*
+   exception base declares its fault class (a class-level
+   ``tfs_fault_class = ...`` or an instance assignment in a method) —
+   a RuntimeError subclass whose message happens to contain a status
+   token ("INTERNAL: ...") would otherwise be pattern-matched into a
+   retry loop. Subclassing an in-package error type inherits the
+   declaration and is exempt;
+2. ``except Exception: pass`` with NO comment on either line is
+   flagged — a silent swallow must say why swallowing is correct
+   (the codebase convention: ``pass  # client hung up mid-error``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Project
+
+CODE = "TFS005"
+NAME = "fault-typing"
+
+#: builtin exception bases: deriving from one of these *directly* makes
+#: the class's fault classification implicit (message pattern-matching)
+#: unless it declares tfs_fault_class
+_BUILTIN_BASES = {
+    "Exception", "BaseException", "RuntimeError", "ValueError",
+    "TypeError", "KeyError", "IndexError", "OSError", "IOError",
+    "TimeoutError", "ArithmeticError", "FloatingPointError",
+    "AssertionError", "AttributeError", "NotImplementedError",
+    "StopIteration", "ConnectionError", "LookupError",
+}
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _declares_fault_class(cls: ast.ClassDef) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "tfs_fault_class":
+                    return True
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "tfs_fault_class"
+                ):
+                    return True
+        elif isinstance(node, ast.AnnAssign):
+            t = node.target
+            if isinstance(t, ast.Name) and t.id == "tfs_fault_class":
+                return True
+            if isinstance(t, ast.Attribute) and t.attr == "tfs_fault_class":
+                return True
+    return False
+
+
+def _is_exception_class(cls: ast.ClassDef) -> bool:
+    """Directly derived from a builtin exception base (by name)."""
+    return any(b in _BUILTIN_BASES for b in _base_names(cls))
+
+
+class FaultTypingCheck:
+    code = CODE
+    name = NAME
+    description = (
+        "exception classes declare tfs_fault_class; "
+        "`except Exception: pass` carries a why-comment"
+    )
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef) and _is_exception_class(
+                    node
+                ):
+                    if not _declares_fault_class(node):
+                        out.append(
+                            Finding(
+                                CODE, mod.rel, node.lineno,
+                                f"exception class `{node.name}` does "
+                                "not declare tfs_fault_class — "
+                                "runtime.faults.classify falls back to "
+                                "message pattern-matching, which can "
+                                "retry a deterministic error whose text "
+                                "contains a status token",
+                            )
+                        )
+                elif isinstance(node, ast.ExceptHandler):
+                    out.extend(self._check_swallow(mod, node))
+        return out
+
+    def _check_swallow(self, mod, node: ast.ExceptHandler) -> List[Finding]:
+        t = node.type
+        names = []
+        if isinstance(t, ast.Name):
+            names = [t.id]
+        elif isinstance(t, ast.Tuple):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        # a bare `except:` is the strictly wider (BaseException) form
+        # of the same swallow — type is None on the handler node
+        broad = t is None or any(
+            n in ("Exception", "BaseException") for n in names
+        )
+        if not broad:
+            return []
+        if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+            return []
+        pass_line = node.body[0].lineno
+
+        def _why(lineno: int) -> bool:
+            # a tfslint suppression marker is not a why-comment — the
+            # suppression machinery (and its REQUIRED reason) owns it
+            c = mod.line_comment(lineno)
+            return bool(c) and "tfslint:" not in c
+
+        if _why(pass_line) or _why(node.lineno):
+            return []  # the swallow says why — that is the invariant
+        return [
+            Finding(
+                CODE, mod.rel, pass_line,
+                "silent `except Exception: pass` — say WHY swallowing "
+                "is correct here (a trailing comment on the pass/except "
+                "line satisfies the check)",
+            )
+        ]

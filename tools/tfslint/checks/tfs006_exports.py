@@ -1,0 +1,66 @@
+"""TFS006: export/docs parity for the public package surface.
+
+Every name in the scanned package root's ``__all__`` must appear (as a
+word) in the docs file (`docs/API.md`). The API reference opens with
+"The public surface (`tensorframes_tpu.__all__`)" — this check is what
+keeps that sentence true as exports accrete.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, Project
+from ._astutil import const_str
+
+CODE = "TFS006"
+NAME = "export-docs-parity"
+
+
+def _find_all(tree: ast.Module):
+    """The module's ``__all__`` list: (lineno, [names]) or None."""
+    for node in tree.body:
+        target: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target, value = node.target, node.value
+        else:
+            continue
+        if isinstance(target, ast.Name) and target.id == "__all__":
+            if isinstance(value, (ast.List, ast.Tuple)):
+                names = [
+                    (const_str(e), e.lineno)
+                    for e in value.elts
+                    if const_str(e) is not None
+                ]
+                return node.lineno, names
+    return None
+
+
+class ExportDocsCheck:
+    code = CODE
+    name = NAME
+    description = "every __all__ export has a docs/API.md row"
+
+    def run(self, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        if project.docs_text is None:
+            return out  # no docs target: parity is unverifiable here
+        for mod in project.root_inits():
+            found = _find_all(mod.tree)
+            if found is None:
+                continue
+            _, names = found
+            for name, lineno in names:
+                if not project.docs_has_word(name):
+                    out.append(
+                        Finding(
+                            CODE, mod.rel, lineno,
+                            f"public export `{name}` has no row in "
+                            f"{project.docs_path} — the API reference "
+                            "claims to cover the whole __all__ surface",
+                        )
+                    )
+        return out

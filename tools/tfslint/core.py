@@ -1,0 +1,255 @@
+"""tfslint framework: parsed-module cache, findings, suppressions.
+
+The engine parses every target file ONCE (`ParsedModule` holds source,
+lines and the `ast` tree) and hands the shared cache to each check —
+six checks over ~130 files must not mean six parses per file. Checks
+are small classes with one entry point (`run(project)`); per-file logic
+rides `ast.NodeVisitor` subclasses inside them, cross-file logic
+(export tables, the `_PROM_HELP` registry, docs parity) reads the whole
+`Project`.
+
+Suppressions are line-scoped comments with a REQUIRED reason::
+
+    something_flagged()  # tfslint: disable=TFS001 holds no user lock
+
+- the suppression disarms the named code(s) on that physical line only;
+- a suppression without a reason is itself a finding (`TFS000`) and
+  cannot be suppressed — every shipped suppression carries its "why";
+- suppressions that disarm nothing are reported as notes (stderr),
+  not failures, so a fixed finding nudges its stale marker out.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+#: the meta-code for broken suppressions (missing reason / unknown
+#: check id) — deliberately not suppressible
+META_CODE = "TFS000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tfslint:\s*disable=([A-Za-z0-9_,]+)[ \t]*(.*?)\s*$"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One invariant violation at ``path:line``."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.code}{tag} {self.message}"
+
+    def to_json(self) -> Dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "suppress_reason": self.suppress_reason,
+        }
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    codes: List[str]
+    reason: str
+    used: bool = False
+
+
+class ParsedModule:
+    """One parsed source file: text, physical lines, AST, suppressions.
+
+    ``rel`` is the path findings are reported under (relative to the
+    scan root's parent, so `tensorframes_tpu/api.py` reads naturally
+    from the repo root)."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.source = path.read_text()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=str(path))
+        # REAL comments only, via tokenize — a `# tfslint: ...` example
+        # quoted inside a docstring or string literal must neither
+        # register as a suppression nor count as a why-comment
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # the file already ast-parsed, so this is a backstop: fall
+            # back to the crude line scan rather than losing markers
+            for i, text in enumerate(self.lines, start=1):
+                if "#" in text:
+                    self.comments[i] = text[text.index("#"):]
+        self.suppressions: Dict[int, Suppression] = {}
+        for i, text in self.comments.items():
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                codes = [c.strip().upper() for c in m.group(1).split(",")]
+                self.suppressions[i] = Suppression(
+                    i, [c for c in codes if c], m.group(2).strip()
+                )
+
+    def line_comment(self, lineno: int) -> Optional[str]:
+        """The comment on a physical line, if any (tokenize-derived —
+        never text inside a string literal)."""
+        return self.comments.get(lineno)
+
+
+class Project:
+    """The shared scan state every check reads: the parsed-module cache,
+    the scan roots, and the docs file (API.md) for parity checks."""
+
+    def __init__(
+        self,
+        paths: Sequence[Path],
+        docs_path: Optional[Path] = None,
+    ):
+        self.roots = [Path(p) for p in paths]
+        self.docs_path = docs_path
+        self.docs_text: Optional[str] = (
+            docs_path.read_text()
+            if docs_path is not None and docs_path.is_file()
+            else None
+        )
+        self._docs_words: Optional[set] = None
+        self.modules: List[ParsedModule] = []
+        self.parse_errors: List[str] = []
+        for root in self.roots:
+            for path in self._py_files(root):
+                relto = root.parent
+                try:
+                    rel = str(path.relative_to(relto))
+                except ValueError:  # disjoint drive/root: report absolute
+                    rel = str(path)
+                try:
+                    self.modules.append(ParsedModule(path, rel))
+                except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+                    # unparseable/undecodable files are REPORTED parse
+                    # errors (exit 1 with the rest of the findings),
+                    # never a crash that loses the whole report
+                    self.parse_errors.append(f"{path}: {e}")
+
+    @staticmethod
+    def _py_files(root: Path) -> Iterable[Path]:
+        if root.is_file():
+            return [root] if root.suffix == ".py" else []
+        return sorted(
+            p for p in root.rglob("*.py") if "__pycache__" not in p.parts
+        )
+
+    def root_inits(self) -> List[ParsedModule]:
+        """`__init__.py` files sitting directly at a scan root (the
+        package surface TFS006 audits)."""
+        tops = {(r / "__init__.py").resolve() for r in self.roots}
+        return [m for m in self.modules if m.path.resolve() in tops]
+
+    def docs_has_word(self, word: str) -> bool:
+        """Word-boundary membership in the docs file (cached: API.md is
+        probed once per exported name / config knob)."""
+        if self.docs_text is None:
+            return False
+        if self._docs_words is None:
+            self._docs_words = set(
+                re.findall(r"[A-Za-z_][A-Za-z0-9_]*", self.docs_text)
+            )
+        return word in self._docs_words
+
+
+def _apply_suppressions(
+    project: Project,
+    findings: List[Finding],
+    known_codes: Optional[set] = None,
+) -> List[Finding]:
+    """Mark findings disarmed by a same-line suppression; append the
+    meta-findings for broken suppressions (no reason, or — when
+    ``known_codes`` is given — a check id that does not exist)."""
+    by_mod = {m.rel: m for m in project.modules}
+    for f in findings:
+        mod = by_mod.get(f.path)
+        if mod is None:
+            continue
+        sup = mod.suppressions.get(f.line)
+        if sup is not None and f.code in sup.codes:
+            if not sup.reason:
+                continue  # a reasonless suppression disarms nothing
+            f.suppressed = True
+            f.suppress_reason = sup.reason
+            sup.used = True
+    for mod in project.modules:
+        for sup in mod.suppressions.values():
+            if not sup.reason:
+                findings.append(
+                    Finding(
+                        META_CODE, mod.rel, sup.line,
+                        "suppression without a reason — write WHY the "
+                        "invariant does not apply here: "
+                        "`# tfslint: disable=<code> <reason>`",
+                    )
+                )
+                continue
+            if known_codes is not None:
+                unknown = [c for c in sup.codes if c not in known_codes]
+                if unknown:
+                    findings.append(
+                        Finding(
+                            META_CODE, mod.rel, sup.line,
+                            "suppression names unknown check id(s) "
+                            f"{', '.join(unknown)} — a typo'd marker "
+                            "disarms nothing and would otherwise rot "
+                            "in place",
+                        )
+                    )
+    return findings
+
+
+def unused_suppressions(project: Project) -> List[str]:
+    """Suppressions that disarmed nothing this run (stale markers) —
+    reported as notes, never as failures."""
+    out = []
+    for mod in project.modules:
+        for sup in mod.suppressions.values():
+            if sup.reason and not sup.used:
+                out.append(
+                    f"{mod.rel}:{sup.line}: unused suppression for "
+                    f"{','.join(sup.codes)}"
+                )
+    return out
+
+
+def run_checks(
+    project: Project,
+    checks: Iterable,
+    known_codes: Optional[set] = None,
+) -> List[Finding]:
+    """Run every check over the shared project; apply suppressions;
+    return findings sorted by location (suppressed ones included,
+    marked). ``known_codes`` is the FULL check registry (plus the meta
+    code) — when given, a suppression naming an id outside it is a
+    TFS000 finding even if only a subset of checks ran."""
+    findings: List[Finding] = []
+    for check in checks:
+        findings.extend(check.run(project))
+    findings = _apply_suppressions(project, findings, known_codes)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings

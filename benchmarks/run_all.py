@@ -65,6 +65,14 @@ SMOKE_SIZES = {
     "INGEST_GROUPS": "2",
     "INGEST_GROUP_ROWS": "20000",
     "INGEST_ITERS": "2",
+    "PLANPIPE_SHARDS": "4",
+    "PLANPIPE_GROUPS": "2",
+    "PLANPIPE_GROUP_ROWS": "20000",
+    "PLANPIPE_ITERS": "2",
+    # cache smoke keeps the DEEP-CHAIN geometry (the hit-vs-recompute
+    # contract is about compute depth, not row volume) and trims rows
+    "PLANPIPE_CACHE_ROWS": "100000",
+    "PLANPIPE_CACHE_DEPTH": "24",
     "OVERLOAD_ROWS": "100000",
     "OVERLOAD_BLOCKS": "4",
     "OVERLOAD_CALLS": "6",
@@ -117,6 +125,7 @@ def main():
         "ragged_map_rows_bench",
         "stream_overlap_bench",
         "ingest_bench",
+        "plan_pipeline_bench",
         "checkpoint_bench",
         "overload_bench",
         "serving_bench",

@@ -335,6 +335,57 @@ class Config:
             minimum=1,
         )
     )
+    # Pipelined plan execution (`lazy.force` over the stage-graph
+    # runtime from `ingest.pipeline`): block feed-prep (slice + pad +
+    # device staging) for block k+1 runs on a pipeline stage while the
+    # consumer thread dispatches block k, so H2D transfer overlaps
+    # compute across the plan's blocks. Off = the historical
+    # block-serial loop (prep and dispatch interleaved on one thread) —
+    # the A/B baseline benchmarks/plan_pipeline_bench.py measures
+    # against, and the single-core escape hatch. Env override
+    # TFS_PLAN_PIPELINE ("0" disables) seeds the initial value.
+    plan_pipeline: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_PLAN_PIPELINE", True, "plan_pipeline"
+        )
+    )
+    # Delivery-queue bound of the plan pipeline: how many prepared
+    # blocks may sit ready ahead of the dispatching consumer. The
+    # prep stage holds at most depth+2 blocks' feeds beyond the
+    # in-flight dispatch (the ingest pipeline's W + 2*depth + 4 queue
+    # bound with W=1), so peak extra host memory is ~that many blocks.
+    # Env override TFS_PLAN_PIPELINE_DEPTH seeds the initial value.
+    plan_pipeline_depth: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_PLAN_PIPELINE_DEPTH", 2, "plan_pipeline_depth",
+            minimum=1,
+        )
+    )
+    # Materialization cache byte budget (`runtime.materialize`): total
+    # on-disk bytes the content-keyed result cache may hold; LRU
+    # entries evict to stay under it. 0 (the default) disables the
+    # cache entirely — zero behavior change, no files written. Keys
+    # are (data fingerprint, program fingerprint, config digest), so a
+    # numerics-relevant knob change can never serve a stale result.
+    # Env override TFS_MATERIALIZE_CACHE_BYTES seeds the initial value.
+    materialize_cache_bytes: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_MATERIALIZE_CACHE_BYTES", 0, "materialize_cache_bytes",
+            minimum=0,
+        )
+    )
+    # Materialization cache directory: where `runtime.materialize`
+    # commits its entries (atomic temp-file + os.replace, same
+    # discipline as runtime.checkpoint). Empty (the default) = a
+    # process-private temp directory created on first store (entries
+    # die with the process); set a persistent path to share warm
+    # results across processes. Env override TFS_MATERIALIZE_CACHE_DIR
+    # seeds the initial value.
+    materialize_cache_dir: str = dataclasses.field(
+        default_factory=lambda: _env_str(
+            "TFS_MATERIALIZE_CACHE_DIR", "", "materialize_cache_dir"
+        )
+    )
     # Decode thread-pool width for multi-file datasets
     # (`ingest.dataset.IngestStream`): 0 = auto (min(4, host cores)).
     # pyarrow releases the GIL inside Parquet/IPC decode, so workers

@@ -93,6 +93,7 @@ _stats: Dict = {
     "pad_rows": 0,          # synthetic rows on sharded lead dims
     "fallbacks": {},        # reason -> count (why a dispatch left the path)
     "last_shards": None,    # data-axis size of the most recent mesh
+    "stream_folds": 0,      # eager double-buffer folds on streaming reduces
 }
 
 
@@ -116,6 +117,17 @@ def _note_dispatch(verb: str, collectives: int = 0) -> None:
     _tele.counter_inc("global_dispatches", 1.0, verb=verb)
     if collectives:
         _tele.counter_inc("global_collectives", float(collectives))
+
+
+def _note_stream_fold() -> None:
+    """One eager fold of `reduce_blocks_stream`'s double-buffered
+    accumulator (a single SPMD combine dispatch that overlapped the
+    next chunk's sharded H2D transfer)."""
+    from .utils import telemetry as _tele
+
+    with _state_lock:
+        _stats["stream_folds"] += 1
+    _tele.counter_inc("global_stream_folds", 1.0)
 
 
 def _note_fallback(reason: str) -> None:
@@ -155,6 +167,7 @@ def state() -> Dict:
             "pad_rows": _stats["pad_rows"],
             "fallbacks": dict(_stats["fallbacks"]),
             "shards": _stats["last_shards"],
+            "stream_folds": _stats["stream_folds"],
         }
 
 
@@ -162,7 +175,7 @@ def reset_state() -> None:
     with _state_lock:
         _stats.update(
             frames=0, dispatches=0, collectives=0, pad_rows=0,
-            fallbacks={}, last_shards=None,
+            fallbacks={}, last_shards=None, stream_folds=0,
         )
 
 

@@ -584,6 +584,7 @@ def pipelined(
     stages: Sequence[PipeStage] = (),
     depth: Optional[int] = None,
     ordinal_base: int = 0,
+    inline: Optional[bool] = None,
 ):
     """Run ``source`` through ``stages`` as a concurrently-executing
     stage graph and yield the results in order.
@@ -592,7 +593,12 @@ def pipelined(
     ``config.stream_prefetch_depth``); the full chunk-memory bound is
     documented in the module docstring. With ``config.ingest_pipeline``
     off, runs the same stages inline on the consumer thread
-    (stage-serial). ``ordinal_base`` offsets every chunk ordinal (span
+    (stage-serial). ``inline`` overrides that gate for callers whose
+    on/off switch is a DIFFERENT knob (the pipelined plan loop in
+    `lazy.force` gates on ``config.plan_pipeline``): ``True`` forces
+    the stage-serial inline path, ``False`` forces the threaded graph,
+    ``None`` (default) follows ``config.ingest_pipeline``.
+    ``ordinal_base`` offsets every chunk ordinal (span
     labels, ``tfs_chunk_index`` stamps): a RESUMED durable stream
     re-enters the pipeline at its committed watermark, and a failure at
     post-resume chunk 3 must name the GLOBAL ordinal, not the third
@@ -611,7 +617,9 @@ def pipelined(
     depth = max(1, int(depth))
     ordinal_base = max(0, int(ordinal_base))
     stages = list(stages)
-    if not getattr(cfg, "ingest_pipeline", True):
+    if inline is None:
+        inline = not getattr(cfg, "ingest_pipeline", True)
+    if inline:
         yield from _serial_pipeline(source, stages, ordinal_base)
         return
 

@@ -646,6 +646,38 @@ class LazyFrame:
         _api._require_dense(
             frame, [self._feed_map[n] for n in feed_names], "lazy.force"
         )
+        # materialization cache (runtime.materialize, OFF by default):
+        # a repeated (data, plan) pair under the same numerics config
+        # returns the committed result with ZERO verb dispatches — only
+        # for the default execution context (an explicit executor /
+        # mesh / devices override is a one-off, like the memo) and only
+        # for host-resident bases (fingerprinting a device frame would
+        # force a hidden D2H sync)
+        import time as _time
+
+        cache_fp = None
+        _mat = None
+        if (
+            executor is None and mesh is None and devices is None
+            and self._executor is None and use_mesh is None
+            and use_devices is None
+        ):
+            from .runtime import materialize as _matmod
+
+            if _matmod.enabled():
+                data_fp = _matmod.frame_fingerprint(frame)
+                if data_fp is not None:
+                    _mat = _matmod
+                    plan_fp = _matmod.plan_fingerprint(
+                        self._graph.fingerprint(), self._feed_map,
+                        out_names,
+                    )
+                    hit = _matmod.lookup(data_fp, plan_fp)
+                    if hit is not None:
+                        self._forced = hit
+                        return hit
+                    cache_fp = (data_fp, plan_fp)
+        t_compute0 = _time.perf_counter()
         with record("lazy.force", frame.nrows):
             gout = None
             if use_mesh is None and frame.nrows > 0:
@@ -703,11 +735,13 @@ class LazyFrame:
                 fscope = _flt.scope("lazy.force")
                 fp = self._graph.fingerprint()
 
-                def _dispatch_rows(bi, lo_, hi_, depth):
-                    # classified faults, same recipe as eager
-                    # map_blocks: transient retries (+ failover under
-                    # the scheduler); OOM splits the row range in half
-                    # for row-local fused chains and concatenates
+                def _prep_block(bi, lo_, hi_):
+                    # feed prep for one block: slice, pad to the bucket
+                    # rung, and (scheduled path) issue the async H2D
+                    # copy toward the block's assigned device. On the
+                    # pipelined path this runs on the plan-prep stage
+                    # thread, so block k+1's transfer is in flight
+                    # while the consumer dispatches block k.
                     feeds = [
                         frame.column(self._feed_map[n]).values[lo_:hi_]
                         for n in feed_names
@@ -715,6 +749,30 @@ class LazyFrame:
                     bucket = hi_ - lo_
                     if bucketed:
                         feeds, bucket = _sp.pad_feeds(feeds, hi_ - lo_)
+                    dev = sched.device(bi) if sched is not None else None
+                    if dev is not None:
+                        import jax
+
+                        try:
+                            feeds = [
+                                jax.device_put(fv, dev) for fv in feeds
+                            ]
+                        except Exception:
+                            pass  # bind re-puts at dispatch time anyway
+                    return feeds, bucket
+
+                def _dispatch_rows(bi, lo_, hi_, depth, prepped=None):
+                    # classified faults, same recipe as eager
+                    # map_blocks: transient retries (+ failover under
+                    # the scheduler); OOM splits the row range in half
+                    # for row-local fused chains and concatenates.
+                    # ``prepped`` carries the plan-prep stage's
+                    # (feeds, bucket) on the pipelined path; splits
+                    # always re-slice from the frame.
+                    if prepped is not None:
+                        feeds, bucket = prepped
+                    else:
+                        feeds, bucket = _prep_block(bi, lo_, hi_)
 
                     def _thunk():
                         # per-attempt span (see map_blocks)
@@ -758,29 +816,71 @@ class LazyFrame:
                     return _sp.slice_pad_rows(outs, hi_ - lo_, bucket)
 
                 acc: Dict[str, List] = {n: [] for n in out_names}
+
+                def _consume(bi, lo, hi, prepped=None):
+                    outs = _dispatch_rows(bi, lo, hi, 0, prepped)
+                    maybe_check_numerics(
+                        out_names, outs, f"lazy fused block {bi}"
+                    )
+                    for n, o in zip(out_names, outs):
+                        if o.ndim == 0 or o.shape[0] != hi - lo:
+                            raise ValueError(
+                                f"lazy plan output {n!r} does not "
+                                "preserve the block row count; "
+                                "trimmed/reducing stages cannot be "
+                                "part of a lazy map plan"
+                            )
+                        acc[n].append(o)
+
+                blocks = [
+                    (bi, frame.offsets[bi], frame.offsets[bi + 1])
+                    for bi in range(frame.num_blocks)
+                    if frame.offsets[bi] != frame.offsets[bi + 1]
+                ]
+                # pipelined plan execution (config.plan_pipeline): the
+                # per-block feed prep + H2D transfer runs as a stage of
+                # the shared stage-graph runtime, depth-bounded by
+                # config.plan_pipeline_depth, while this thread keeps
+                # dispatching — block k+1's transfer overlaps block k's
+                # map/reduce. Dispatch (fault scope, scheduler, deadline
+                # checks, telemetry parents) stays on this thread.
+                use_pipe = (
+                    _lconfig.get().plan_pipeline and len(blocks) >= 2
+                )
                 # stage spans: the block loop (host prep + dispatch)
                 # and output collection are the plan stages
                 # explain_analyze attributes wall time to
                 with _tele.span(
                     "lazy.force.blocks", kind="stage", program=fp
                 ):
-                    for bi in range(frame.num_blocks):
-                        lo, hi = frame.offsets[bi], frame.offsets[bi + 1]
-                        if lo == hi:
-                            continue
-                        outs = _dispatch_rows(bi, lo, hi, 0)
-                        maybe_check_numerics(
-                            out_names, outs, f"lazy fused block {bi}"
+                    if use_pipe:
+                        import contextlib as _ctx
+
+                        from .ingest.pipeline import (
+                            PipeStage,
+                            pipelined,
                         )
-                        for n, o in zip(out_names, outs):
-                            if o.ndim == 0 or o.shape[0] != hi - lo:
-                                raise ValueError(
-                                    f"lazy plan output {n!r} does not "
-                                    "preserve the block row count; "
-                                    "trimmed/reducing stages cannot be "
-                                    "part of a lazy map plan"
-                                )
-                            acc[n].append(o)
+
+                        def _prep_stage(item):
+                            bi_, lo_, hi_ = item
+                            feeds, bucket = _prep_block(bi_, lo_, hi_)
+                            return (bi_, lo_, hi_, (feeds, bucket))
+
+                        block_iter = pipelined(
+                            iter(blocks),
+                            [PipeStage("plan-prep", _prep_stage)],
+                            depth=_lconfig.get().plan_pipeline_depth,
+                            inline=False,
+                        )
+                        # a consumer-side failure (dispatch error, OOM
+                        # reraise) must tear the prep stage down
+                        # deterministically, not at GC
+                        with _ctx.closing(block_iter):
+                            for bi, lo, hi, prepped in block_iter:
+                                _consume(bi, lo, hi, prepped)
+                    else:
+                        for bi, lo, hi in blocks:
+                            _consume(bi, lo, hi)
                 vinfo = self.info
                 with _tele.span("lazy.force.collect", kind="stage"):
                     anchor = (
@@ -810,6 +910,16 @@ class LazyFrame:
                         if c not in shadow
                     ]
                     out = TensorFrame(cols, frame.offsets)
+        if cache_fp is not None:
+            # offer the result to the materialization cache (admission
+            # is priced inside: modeled recompute vs measured
+            # store+load; a failed force never reaches here, so a
+            # partially-computed result can never be committed)
+            _mat.store(
+                cache_fp[0], cache_fp[1], out,
+                ledger_fp=self._graph.fingerprint(),
+                compute_s=_time.perf_counter() - t_compute0,
+            )
         if executor is None and mesh is None and devices is None:
             self._forced = out
         return out
@@ -819,6 +929,40 @@ class LazyFrame:
 
     def collect(self):
         return self.force().collect()
+
+    def collect_async(self):
+        """Force the plan on a background daemon thread and return a
+        `concurrent.futures.Future` of ``collect()``'s result, so the
+        caller overlaps host work with device work.
+
+        The ambient deadline/admission context is COPIED at call time
+        (contextvars do not flow into threads by themselves): inside a
+        ``tfs.deadline_scope`` the async force inherits the scope's
+        budget — an expired or cancelled scope resolves the future
+        with the typed `DeadlineExceeded` / `Cancelled` — and a
+        collect_async issued inside a verb never takes a second
+        admission slot (the nested-verb rule rides the copied
+        context). A failed force never commits a materialization-cache
+        entry: the store only runs after a fully-computed result."""
+        import contextvars
+        import threading
+        from concurrent.futures import Future
+
+        ctx = contextvars.copy_context()
+        fut: Future = Future()
+
+        def _run():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(ctx.run(lambda: self.force().collect()))
+            except BaseException as e:  # typed deadline errors included
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=_run, name="tfs-collect-async", daemon=True
+        ).start()
+        return fut
 
     def to_pandas(self):
         return self.force().to_pandas()

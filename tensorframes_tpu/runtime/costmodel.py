@@ -359,6 +359,31 @@ def program_costs() -> Dict[str, Dict]:
     return out
 
 
+def modeled_recompute_s(fp: str) -> Optional[float]:
+    """Predicted seconds to recompute ONE execution of program ``fp``:
+    the ledger's per-exec modeled cost (largest captured shape)
+    converted through the fitted effective throughput of
+    `residuals()` — the admission price the materialization cache
+    compares against its measured store+load cost. ``None`` when the
+    ledger has no costed shape for the program or no residual fit
+    exists yet (no dispatch spans to fit against)."""
+    if not enabled():
+        return None
+    costs = program_costs().get(fp)
+    if costs is None:
+        return None
+    try:
+        fit = residuals()["fit"]
+    except Exception:
+        return None
+    pred = None
+    if costs["bytes_per_exec"] is not None and fit.get("bytes_per_s"):
+        pred = costs["bytes_per_exec"] / fit["bytes_per_s"]
+    elif costs["flops_per_exec"] is not None and fit.get("flops_per_s"):
+        pred = costs["flops_per_exec"] / fit["flops_per_s"]
+    return pred
+
+
 def program_shapes() -> Dict[str, List[Dict]]:
     """Per-(program, kind, shape) ledger detail: one row per captured
     shape entry with its lead row count (the BUCKET rows of a padded

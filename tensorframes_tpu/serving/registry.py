@@ -201,13 +201,38 @@ class Endpoint:
 
     # -- execution ------------------------------------------------------
     def run_frame(
-        self, frame: TensorFrame, timeout_s: Optional[float] = None
+        self,
+        frame: TensorFrame,
+        timeout_s: Optional[float] = None,
+        _use_cache: bool = True,
     ) -> TensorFrame:
         """Run the endpoint's program on ``frame`` and return ONLY the
         fetch outputs (renamed to the registered output names) — the
-        response never echoes request columns back over the wire."""
-        from .. import api as _api
+        response never echoes request columns back over the wire.
 
+        When the materialization cache is on
+        (``config.materialize_cache_bytes`` > 0), a repeated
+        (request bytes, program, config) triple is served from the
+        cache without dispatching — the RENAMED response frame is what
+        gets keyed, so a hit is byte-for-byte the wire answer. Warm
+        compiles pass ``_use_cache=False``: their synthetic frames must
+        reach the device to build the jit cache, and their results are
+        not real answers worth a cache slot."""
+        from .. import api as _api
+        from ..runtime import materialize as _mat
+
+        cache_key = None
+        if _use_cache and self.executor is None and _mat.enabled():
+            data_fp = _mat.frame_fingerprint(frame)
+            if data_fp is not None:
+                plan_fp = _mat.plan_fingerprint(
+                    self.fingerprint, self.feed_dict, self.output_names
+                )
+                hit = _mat.lookup(data_fp, plan_fp)
+                if hit is not None:
+                    return hit
+                cache_key = (data_fp, plan_fp)
+        t0 = time.perf_counter()
         res = _api.map_blocks(
             self.graph,
             frame,
@@ -220,7 +245,14 @@ class Endpoint:
             Column(out, res.column(_base(edge)).values)
             for out, edge in zip(self.output_names, self.fetch_edges)
         ]
-        return TensorFrame(cols, offsets=[0, frame.nrows])
+        out_frame = TensorFrame(cols, offsets=[0, frame.nrows])
+        if cache_key is not None:
+            _mat.store(
+                cache_key[0], cache_key[1], out_frame,
+                ledger_fp=self.fingerprint,
+                compute_s=time.perf_counter() - t0,
+            )
+        return out_frame
 
     # -- warm compile ---------------------------------------------------
     def warm(self) -> Tuple[int, ...]:
@@ -243,7 +275,9 @@ class Endpoint:
             rungs=len(rungs),
         ):
             for rung in rungs:
-                self.run_frame(_schema_frame(self.schema, rung))
+                self.run_frame(
+                    _schema_frame(self.schema, rung), _use_cache=False
+                )
         self.warmed_rungs = rungs
         _tele.counter_inc(
             "serve_warm_rungs", float(len(rungs)), endpoint=self.name

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import config as _config
 from .aggregate import _chunk_combiners
 from .frame import TensorFrame
 from .graph.analysis import analyze_graph
@@ -53,6 +54,29 @@ def _prefetch_iter(it, depth=None, stage=None):
 
     stages = [] if stage is None else [PipeStage("transfer-stage", stage)]
     return pipelined(it, stages, depth=depth)
+
+
+def _spill_partial_to_host(part: Dict, chunk: int) -> Dict:
+    """D2H-spill one partial table to host numpy through the ONE
+    accounting path every stream spill shares: a ``host_sync`` span +
+    counter and the ``d2h_bytes`` histogram. The unfoldable-stream
+    spill, the double-buffer stand-down and the materialization cache's
+    serialize step all report through this shape, so diagnostics see
+    every forced device-to-host sync the same way. Host-resident
+    partials pass through untouched (and cost nothing)."""
+    if all(isinstance(v, np.ndarray) for v in part.values()):
+        return part
+    with _telemetry.span(
+        "reduce_blocks_stream.spill", kind="host_sync", chunk=chunk,
+    ):
+        spilled = {k: np.asarray(v) for k, v in part.items()}
+    record_count("host_sync")
+    if _telemetry.enabled():
+        _telemetry.histogram_observe(
+            "d2h_bytes",
+            float(sum(v.nbytes for v in spilled.values())),
+        )
+    return spilled
 
 
 from .runtime.deadline import deadline_entry as _deadline_entry
@@ -365,6 +389,23 @@ def reduce_blocks_stream(
     from .runtime.deadline import Cancelled, DeadlineExceeded
 
     partials: List[Dict] = list(restored)
+    # Double-buffered accumulator (global streaming path): instead of
+    # parking ``fold_every`` partials and tree-folding them in one
+    # burst, fold chunk k's partial eagerly into one of TWO alternating
+    # slots. Each slot is an independent dependency chain, so the async
+    # one-SPMD-dispatch fold of chunk k (slot k%2) runs while chunk
+    # k+1's sharded device_put is in flight AND chunk k+1's own fold
+    # lands on the OTHER slot — the fold never serializes against the
+    # H2D transfer. Device-resident partials drop from O(fold_every)
+    # parked tables to O(2). Active only for streams that would
+    # tree-fold anyway (same associativity contract; pairwise
+    # reassociation stays within the documented float-sum tolerance;
+    # min/max/prod/int-sum are exact), never for durable streams (the
+    # checkpoint protocol commits the partials LIST), and gated on
+    # ``config.plan_pipeline`` so the A/B benchmark can hold it still.
+    dbuf: List[Optional[Dict]] = [None, None]
+    dbuf_n = [0]
+    dbuf_ok = [True]
     # `ordinal` counts source chunks FULLY consumed (committed ones
     # included): the candidate watermark. Empty chunks advance it —
     # they contribute the reduction identity, and a resume must not
@@ -482,57 +523,76 @@ def reduce_blocks_stream(
                     # transfer/compute)
                     devices=[chunk_dev] if chunk_dev is not None else None,
                 )
-            partials.append(
-                r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+            part = r if isinstance(r, dict) else {_base(fetch_list[0]): r}
+            use_dbuf = (
+                dbuf_ok[0] and gmesh is not None and not gmesh_off[0]
+                and ckpt is None and fold_every is not None
+                and _config.get().plan_pipeline
             )
-            # advance the candidate watermark the moment the chunk's
-            # contribution is IN `partials`: from here on
-            # (ordinal, partials) is a committable state even if the
-            # fold below is interrupted mid-combine (a fold only
-            # reorganizes contributions, it never adds one)
-            ordinal += 1
-            if fold_every is not None and len(partials) >= fold_every:
-                with _telemetry.span(
-                    "reduce_blocks_stream.fold", kind="stage"
-                ):
-                    partials = [_combine(partials)]
-            elif fold_every is None and len(partials) > 1:
-                # no tree-fold will ever drain this list: spill the
-                # PREVIOUS chunk's (already computed) partial to host so
-                # unfoldable streams cost O(#chunks) host RAM — the
-                # documented bound — not device HBM. The newest partial
-                # stays on device, so the current dispatch still
-                # overlaps the next chunk's production/transfer. The
-                # spill is a real D2H sync and is accounted as one
-                # (host_sync span/counter + d2h bytes) — diagnostics
-                # previously under-reported D2H traffic on long
-                # unfoldable streams.
-                spill_src = partials[-2]
-                if any(
-                    not isinstance(v, np.ndarray)
-                    for v in spill_src.values()
-                ):
-                    with _telemetry.span(
-                        "reduce_blocks_stream.spill", kind="host_sync",
-                        chunk=len(partials) - 2,
-                    ):
-                        spilled = {
-                            k: np.asarray(v) for k, v in spill_src.items()
-                        }
-                    record_count("host_sync")
-                    if _telemetry.enabled():
-                        _telemetry.histogram_observe(
-                            "d2h_bytes",
-                            float(
-                                sum(v.nbytes for v in spilled.values())
-                            ),
+            if use_dbuf:
+                slot = dbuf_n[0] % 2
+                dbuf_n[0] += 1
+                if dbuf[slot] is None:
+                    dbuf[slot] = part
+                else:
+                    try:
+                        with _telemetry.span(
+                            "reduce_blocks_stream.fold", kind="stage",
+                            slot=slot,
+                        ):
+                            dbuf[slot] = _combine([dbuf[slot], part])
+                        from . import globalframe as _gfm
+
+                        _gfm._note_stream_fold()
+                    except Exception:
+                        # device pressure (or anything else) mid-fold:
+                        # spill both operands to host through the
+                        # shared D2H accounting path and stand down to
+                        # the tree-fold list for the rest of the stream
+                        dbuf_ok[0] = False
+                        partials.extend(
+                            _spill_partial_to_host(p, ordinal)
+                            for p in (dbuf[slot], part)
                         )
-                    partials[-2] = spilled
+                        dbuf[slot] = None
+                ordinal += 1
+            else:
+                partials.append(part)
+                # advance the candidate watermark the moment the
+                # chunk's contribution is IN `partials`: from here on
+                # (ordinal, partials) is a committable state even if
+                # the fold below is interrupted mid-combine (a fold
+                # only reorganizes contributions, it never adds one)
+                ordinal += 1
+                if fold_every is not None and len(partials) >= fold_every:
+                    with _telemetry.span(
+                        "reduce_blocks_stream.fold", kind="stage"
+                    ):
+                        partials = [_combine(partials)]
+                elif fold_every is None and len(partials) > 1:
+                    # no tree-fold will ever drain this list: spill the
+                    # PREVIOUS chunk's (already computed) partial to
+                    # host so unfoldable streams cost O(#chunks) host
+                    # RAM — the documented bound — not device HBM. The
+                    # newest partial stays on device, so the current
+                    # dispatch still overlaps the next chunk's
+                    # production/transfer. The spill is a real D2H sync
+                    # and is accounted as one (host_sync span/counter +
+                    # d2h bytes) — diagnostics previously
+                    # under-reported D2H traffic on long unfoldable
+                    # streams.
+                    partials[-2] = _spill_partial_to_host(
+                        partials[-2], len(partials) - 2
+                    )
             if ckpt is not None:
                 # the commit point: chunk `ordinal - 1` is fully folded
                 # into `partials`, so (ordinal, partials) is exactly the
                 # state an uninterrupted run holds here
                 ckpt.note_chunk_folded(ordinal, partials)
+        # drain the double-buffer slots into the final combine (at most
+        # two running folds — each already the eager reduction of its
+        # half of the stream)
+        partials.extend(d for d in dbuf if d is not None)
         if not partials:
             raise ValueError(
                 "reduce_blocks_stream over an empty iterator (or every "

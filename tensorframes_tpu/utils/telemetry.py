@@ -948,6 +948,13 @@ _PROM_HELP: Dict[str, str] = {
     "global_fallbacks": (
         "Dispatches that left the global SPMD path, by reason"
     ),
+    "global_stream_folds": (
+        "Eager double-buffer folds on global streaming reduces"
+    ),
+    "materialize_hits": "Materialization-cache hits served without compute",
+    "materialize_misses": "Materialization-cache lookups that missed",
+    "materialize_evictions": "Materialization-cache entries evicted (LRU)",
+    "materialize_bytes": "Bytes held by the materialization cache",
     "admission_wait_seconds": "Time spent queued for a verb slot",
     "admission_queue_depth": "Verbs queued for admission right now",
     "admission_in_flight": "Admitted top-level verbs in flight",
@@ -1207,6 +1214,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["globalframe"] = _globalframe.state()
     except Exception as e:
         data["globalframe"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # materialization cache: hit/store/eviction accounting ---------------
+    try:
+        from ..runtime import materialize as _materialize
+
+        data["materialize"] = _materialize.state()
+    except Exception as e:
+        data["materialize"] = {"error": f"{type(e).__name__}: {e}"}
 
     # executor + recompile-storm signal ---------------------------------
     try:
@@ -1552,6 +1567,45 @@ def _render_diagnostics(data: Dict) -> str:
         )
         for reason, n in sorted(gf.get("fallbacks", {}).items()):
             lines.append(f"  fallback {reason}: {n} dispatch(es)")
+        if gf.get("stream_folds"):
+            lines.append(
+                f"  streaming double-buffer: {gf['stream_folds']} eager "
+                "fold(s) overlapped sharded H2D"
+            )
+
+    # materialization cache ----------------------------------------------
+    mat = data.get("materialize", {})
+    if mat and "error" not in mat and (
+        mat.get("hits") or mat.get("misses") or mat.get("stores")
+        or mat.get("entries")
+    ):
+        lines.append("")
+        lines.append(
+            f"materialization cache: {mat.get('hits', 0)} hit(s), "
+            f"{mat.get('misses', 0)} miss(es), "
+            f"{mat.get('stores', 0)} store(s), "
+            f"{mat.get('evictions', 0)} eviction(s); "
+            f"{mat.get('entries', 0)} entry(ies) holding "
+            f"{_fmt_bytes(mat.get('bytes', 0))} of "
+            f"{_fmt_bytes(mat.get('budget_bytes', 0))} budget"
+        )
+        if mat.get("rejected"):
+            lines.append(
+                f"  {mat['rejected']} store(s) rejected by admission "
+                "pricing (modeled recompute cheaper than store+load)"
+            )
+        if mat.get("drift_refusals") or mat.get("corrupt_dropped"):
+            lines.append(
+                f"  {mat.get('drift_refusals', 0)} drift refusal(s), "
+                f"{mat.get('corrupt_dropped', 0)} corrupt entry(ies) dropped"
+            )
+        lh = mat.get("last_hit")
+        if lh:
+            lines.append(
+                f"  last hit: program {lh['program']} "
+                f"{_fmt_bytes(lh['bytes'])} in "
+                f"{lh['load_seconds'] * 1e3:.1f}ms"
+            )
 
     # executor + recompile-storm signal ---------------------------------
     if "executor_error" in data:

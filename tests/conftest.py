@@ -45,6 +45,7 @@ def _reset_telemetry():
         costmodel,
         deadline,
         faults,
+        materialize,
     )
     from tensorframes_tpu.runtime.scheduler import device_health
     from tensorframes_tpu.utils import telemetry
@@ -59,3 +60,4 @@ def _reset_telemetry():
     deadline.reset()
     checkpoint.reset_state()  # durable-stream accounting never leaks
     globalframe.reset_state()  # SPMD dispatch/fallback ledger never leaks
+    materialize.reset_state()  # cached results never answer another test

@@ -1,0 +1,503 @@
+"""Pipelined plan execution + content-keyed materialization cache
+(ISSUE 17).
+
+The acceptance contracts under test:
+
+- A repeated (data, program, config) triple is served from the cache
+  bit-identically with ZERO verb dispatches on the hit path (asserted
+  via dispatch-span count), and the cache never exceeds
+  ``materialize_cache_bytes`` (LRU eviction is the hard bound).
+- Admission is cost-priced: a result whose modeled/measured recompute
+  is cheaper than its store+load is rejected, not cached.
+- A cache entry whose committed fingerprints drift from the current
+  (data, program, config) is refused loudly, naming the field; a
+  corrupt entry is dropped and recomputed, never a user-visible error.
+- `collect_async()` returns a real future that honors the ambient
+  `deadline_scope`: an expired scope raises typed `DeadlineExceeded`
+  without leaking pipeline threads and without poisoning the cache
+  (the atomic temp-file + os.replace commit means a partially-written
+  entry is never readable).
+- The pipelined plan loop (`config.plan_pipeline`) is bit-identical to
+  the historical block-serial loop, and the double-buffered streaming
+  accumulator folds eagerly on the global path within the documented
+  float tolerance.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config, dsl
+from tensorframes_tpu.frame import TensorFrame
+from tensorframes_tpu.io import frame_to_ipc_bytes
+from tensorframes_tpu.runtime import materialize
+from tensorframes_tpu.runtime.checkpoint import (
+    CheckpointError,
+    CheckpointStore,
+)
+from tensorframes_tpu.utils import telemetry
+
+NDEV = len(jax.local_devices())
+
+multi_device = pytest.mark.skipif(
+    NDEV < 2, reason="needs >1 (virtual) local device"
+)
+
+
+def _frame(n=64, blocks=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return TensorFrame.from_dict(
+        {"x": rng.rand(n).astype(np.float32)}, num_blocks=blocks
+    )
+
+
+def _chain(df):
+    """A fused map chain over ``df`` (tanh(x) * 0.5 + x)."""
+    xi = tfs.block(df, "x", tf_name="x_input")
+    z = (dsl.tanh(xi) * dsl.constant(np.float32(0.5)) + xi).named("z")
+    return df.lazy().map_blocks(z, feed_dict={"x_input": "x"})
+
+
+@pytest.fixture
+def always_admit(monkeypatch):
+    """Pin the admission predicate open: tests of the hit path,
+    integrity and serving behavior must not depend on this machine's
+    disk being slower than a toy program's recompute."""
+    monkeypatch.setattr(
+        materialize, "_priced_out", lambda *a, **k: False
+    )
+
+
+def _dispatch_spans(since_id):
+    return [
+        s for s in telemetry.spans()
+        if s.span_id > since_id and s.kind == "dispatch"
+    ]
+
+
+def _no_pipeline_threads(timeout_s=5.0):
+    """True once no tfs-collect-async / tfs-ingest-* thread is alive
+    (polled: a finished future's thread may still be unwinding)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        leaked = [
+            t.name for t in threading.enumerate()
+            if t.is_alive() and (
+                t.name.startswith("tfs-collect-async")
+                or t.name.startswith("tfs-ingest")
+            )
+        ]
+        if not leaked:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# config knobs (TFS003 contract)
+
+
+class TestConfigKnobs:
+    def test_defaults(self):
+        c = config.Config()
+        assert c.plan_pipeline is True
+        assert c.plan_pipeline_depth == 2
+        assert c.materialize_cache_bytes == 0  # cache is opt-in
+        assert c.materialize_cache_dir == ""
+
+    def test_env_seeding(self, monkeypatch):
+        monkeypatch.setenv("TFS_PLAN_PIPELINE", "0")
+        monkeypatch.setenv("TFS_PLAN_PIPELINE_DEPTH", "5")
+        monkeypatch.setenv("TFS_MATERIALIZE_CACHE_BYTES", "12345")
+        monkeypatch.setenv("TFS_MATERIALIZE_CACHE_DIR", "/tmp/tfs-mat")
+        c = config.Config()
+        assert c.plan_pipeline is False
+        assert c.plan_pipeline_depth == 5
+        assert c.materialize_cache_bytes == 12345
+        assert c.materialize_cache_dir == "/tmp/tfs-mat"
+
+    def test_malformed_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("TFS_PLAN_PIPELINE", "maybe")
+        monkeypatch.setenv("TFS_PLAN_PIPELINE_DEPTH", "zero")
+        monkeypatch.setenv("TFS_MATERIALIZE_CACHE_BYTES", "-3")
+        c = config.Config()
+        assert c.plan_pipeline is True
+        assert c.plan_pipeline_depth == 2
+        assert c.materialize_cache_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# pipelined plan execution
+
+
+class TestPipelinedPlan:
+    def test_pipeline_bit_identical_to_serial(self):
+        df = _frame(n=96, blocks=6)
+        with config.override(plan_pipeline=True):
+            on = _chain(df).force()
+        with config.override(plan_pipeline=False):
+            off = _chain(df).force()
+        np.testing.assert_array_equal(
+            np.asarray(on.column("z").values),
+            np.asarray(off.column("z").values),
+        )
+
+    def test_single_block_stays_serial(self):
+        # nothing to overlap: one block must not spin up a pipeline
+        df = _frame(n=16, blocks=1)
+        out = _chain(df).force()
+        ref = np.tanh(df.column("x").host_values()) * np.float32(0.5)
+        ref = (ref + df.column("x").host_values()).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(out.column("z").values), ref, rtol=1e-6
+        )
+
+    def test_collect_async_matches_collect(self):
+        df = _frame()
+        sync = _chain(df).collect()
+        fut = _chain(df).collect_async()
+        got = fut.result(timeout=60)
+        assert len(got) == len(sync)
+        np.testing.assert_array_equal(
+            np.array([r["z"] for r in got]),
+            np.array([r["z"] for r in sync]),
+        )
+        assert _no_pipeline_threads()
+
+
+# ---------------------------------------------------------------------------
+# materialization cache: hit path, bounds, admission
+
+
+class TestCachePath:
+    def test_disabled_by_default_never_stores(self):
+        df = _frame()
+        _chain(df).force()
+        _chain(df).force()
+        st = materialize.state()
+        assert st["enabled"] is False
+        assert st["stores"] == 0 and st["hits"] == 0
+
+    def test_hit_bit_identical_zero_dispatches(self, tmp_path, always_admit):
+        df = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,  # price by measured wall time -> admit
+            telemetry=True,
+        ):
+            cold = _chain(df).force()
+            assert materialize.state()["stores"] == 1
+            sid0 = telemetry.allocate_span_id()
+            warm = _chain(df).force()
+            assert _dispatch_spans(sid0) == []  # ZERO verb dispatches
+            st = materialize.state()
+            assert st["hits"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(warm.column("z").values),
+            np.asarray(cold.column("z").values),
+        )
+
+    def test_hit_survives_a_fresh_index(self, tmp_path, always_admit):
+        # a user-configured dir outlives the process: reset drops only
+        # the in-memory index, the rescan finds the committed entry
+        df = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,
+        ):
+            cold = _chain(df).force()
+            materialize.reset_state()
+            warm = _chain(df).force()
+            assert materialize.state()["hits"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(warm.column("z").values),
+            np.asarray(cold.column("z").values),
+        )
+
+    def test_different_data_or_program_misses(self, tmp_path, always_admit):
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,
+        ):
+            _chain(_frame(seed=0)).force()
+            _chain(_frame(seed=1)).force()  # same program, new data
+            st = materialize.state()
+            assert st["hits"] == 0 and st["stores"] == 2
+
+    def test_admission_rejects_cheap_recompute(self, tmp_path):
+        frame = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+        ):
+            # recompute modeled at ~zero: storing can never pay off
+            assert not materialize.store(
+                "d" * 16, "p" * 16, frame, compute_s=0.0
+            )
+            st = materialize.state()
+            assert st["rejected"] == 1 and st["entries"] == 0
+            assert list(tmp_path.glob("*.tfsmat")) == []
+
+    def test_unpriceable_result_is_admitted(self, tmp_path):
+        frame = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+        ):
+            assert materialize.store("d" * 16, "p" * 16, frame)
+            assert materialize.state()["entries"] == 1
+
+    def test_lru_eviction_holds_bytes_bound(self, tmp_path):
+        frame = _frame()
+        payload = len(frame_to_ipc_bytes(frame))
+        budget = int(2.5 * payload)
+        with config.override(
+            materialize_cache_bytes=budget,
+            materialize_cache_dir=str(tmp_path),
+        ):
+            for i in range(4):
+                assert materialize.store(
+                    f"data{i:012d}", "p" * 16, frame, compute_s=1e9
+                )
+                st = materialize.state()
+                assert st["bytes"] <= budget  # never exceeded, ever
+            st = materialize.state()
+            assert st["entries"] == 2 and st["evictions"] == 2
+            # the oldest entries are the ones gone
+            assert materialize.lookup("data000000000000", "p" * 16) is None
+            assert (
+                materialize.lookup("data000000000003", "p" * 16)
+                is not None
+            )
+
+    def test_oversized_payload_rejected(self, tmp_path):
+        frame = _frame(n=256)
+        with config.override(
+            materialize_cache_bytes=64,  # smaller than any payload
+            materialize_cache_dir=str(tmp_path),
+        ):
+            assert not materialize.store(
+                "d" * 16, "p" * 16, frame, compute_s=1e9
+            )
+            assert materialize.state()["rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# integrity: drift refused loudly, corruption dropped quietly
+
+
+class TestIntegrity:
+    def _entry_path(self, tmp_path):
+        files = sorted(tmp_path.glob("*.tfsmat"))
+        assert len(files) == 1
+        return str(files[0])
+
+    def test_drifted_fingerprint_refused_naming_field(self, tmp_path, always_admit):
+        df = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,
+        ):
+            _chain(df).force()
+            path = self._entry_path(tmp_path)
+            store = CheckpointStore(path)
+            manifest, payload = store.load()
+            manifest["dataset_fingerprint"] = "0" * 16
+            store.commit(manifest, payload)
+            materialize.reset_state()  # force a rescan of the dir
+            with pytest.raises(CheckpointError) as ei:
+                _chain(df).force()
+            assert ei.value.kind == "drift"
+            assert ei.value.field == "dataset_fingerprint"
+            assert "dataset_fingerprint" in str(ei.value)
+            assert materialize.state()["drift_refusals"] == 1
+
+    def test_corrupt_entry_dropped_and_recomputed(self, tmp_path, always_admit):
+        df = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,
+        ):
+            cold = _chain(df).force()
+            path = self._entry_path(tmp_path)
+            blob = open(path, "rb").read()
+            with open(path, "wb") as f:
+                f.write(blob[: len(blob) // 2])  # truncate mid-payload
+            materialize.reset_state()
+            out = _chain(df).force()  # recomputes, no user-visible error
+            st = materialize.state()
+            assert st["corrupt_dropped"] == 1 and st["hits"] == 0
+            # the recompute re-committed a VALID entry over the dropped
+            # one: the next identical run hits again
+            assert st["stores"] == 1
+            _chain(df).force()
+            assert materialize.state()["hits"] == 1
+        np.testing.assert_array_equal(
+            np.asarray(out.column("z").values),
+            np.asarray(cold.column("z").values),
+        )
+
+
+# ---------------------------------------------------------------------------
+# cancellation / fault interplay
+
+
+class TestAsyncDeadlines:
+    def test_expired_scope_raises_typed_without_poisoning(self, tmp_path):
+        df = _frame()
+        with config.override(
+            materialize_cache_bytes=10_000_000,
+            materialize_cache_dir=str(tmp_path),
+            cost_ledger=False,
+        ):
+            with tfs.deadline_scope(timeout_s=1e-6):
+                time.sleep(0.01)  # the scope is expired before launch
+                fut = _chain(df).collect_async()
+                with pytest.raises(tfs.DeadlineExceeded):
+                    fut.result(timeout=60)
+            assert _no_pipeline_threads()  # no leaked pipeline threads
+            st = materialize.state()
+            assert st["stores"] == 0 and st["entries"] == 0
+            # a partially-written entry is never readable: the atomic
+            # commit (temp file + os.replace) leaves nothing behind
+            assert list(tmp_path.glob("*.tfsmat")) == []
+
+    def test_scope_flows_into_the_worker_thread(self):
+        # the future captures the ambient context: a generous live
+        # scope admits the run and it completes inside the budget
+        df = _frame()
+        with tfs.deadline_scope(timeout_s=120.0):
+            fut = _chain(df).collect_async()
+            got = fut.result(timeout=60)
+        assert len(got) == 64  # one record per row
+        assert _no_pipeline_threads()
+
+
+# ---------------------------------------------------------------------------
+# serving: transparent cache on the endpoint path
+
+
+class TestServingCache:
+    def _register(self):
+        from tensorframes_tpu.schema import ScalarType, Shape
+
+        x = dsl.placeholder(
+            ScalarType.float32, shape=Shape((None,)), name="x"
+        )
+        score = (x * dsl.constant(np.float32(2.0))).named("score")
+        return tfs.serving.register(
+            "mat-score", score, {"x": "float32"}, warm=False
+        )
+
+    def test_repeat_request_served_from_cache(self, tmp_path, always_admit):
+        ep = self._register()
+        try:
+            req = TensorFrame.from_dict(
+                {"x": np.arange(8, dtype=np.float32)}
+            )
+            with config.override(
+                materialize_cache_bytes=10_000_000,
+                materialize_cache_dir=str(tmp_path),
+                cost_ledger=False,
+                telemetry=True,
+            ):
+                cold = ep.run_frame(req)
+                sid0 = telemetry.allocate_span_id()
+                warm = ep.run_frame(req)
+                assert _dispatch_spans(sid0) == []
+                assert materialize.state()["hits"] == 1
+            np.testing.assert_array_equal(
+                np.asarray(warm.column("score").values),
+                np.asarray(cold.column("score").values),
+            )
+        finally:
+            tfs.serving.unregister("mat-score")
+
+
+# ---------------------------------------------------------------------------
+# streaming: double-buffered accumulator (global path)
+
+
+def _stream_chunks(n, rows=64):
+    rng = np.random.RandomState(7)
+    for _ in range(n):
+        yield TensorFrame.from_dict(
+            {"x": rng.rand(rows).astype(np.float32)}
+        )
+
+
+def _stream_ref(n, rows=64):
+    rng = np.random.RandomState(7)
+    return np.concatenate(
+        [rng.rand(rows).astype(np.float32) for _ in range(n)]
+    )
+
+
+def _sum_fetch():
+    proto = TensorFrame.from_dict({"x": np.zeros(4, np.float32)})
+    xi = tfs.block(proto, "x", tf_name="x_input")
+    return dsl.reduce_sum(xi, axes=[0]).named("x")
+
+
+@multi_device
+class TestDoubleBuffer:
+    def test_eager_folds_match_tree_fold(self):
+        from tensorframes_tpu import globalframe
+
+        fetch = _sum_fetch()
+        ref = float(_stream_ref(6).sum())
+        with config.override(
+            block_scheduler="global", plan_pipeline=True,
+            global_frame_min_rows=1,
+        ):
+            on = tfs.reduce_blocks_stream(
+                fetch, _stream_chunks(6), feed_dict={"x_input": "x"},
+                fold_every=2,
+            )
+            folds_on = globalframe.state()["stream_folds"]
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", plan_pipeline=False,
+            global_frame_min_rows=1,
+        ):
+            off = tfs.reduce_blocks_stream(
+                fetch, _stream_chunks(6), feed_dict={"x_input": "x"},
+                fold_every=2,
+            )
+            folds_off = globalframe.state()["stream_folds"]
+        # chunks 0/1 seed the two slots; 2..5 each fold eagerly
+        assert folds_on == 4 and folds_off == 0
+        np.testing.assert_allclose(float(np.asarray(on)), ref, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(on)), float(np.asarray(off)), rtol=1e-5
+        )
+
+    def test_unfoldable_stream_keeps_exact_combine(self):
+        # mean is not tree-foldable: the double buffer must stand
+        # aside (fold_every=None) and the single final combine stays
+        # exact
+        from tensorframes_tpu import globalframe
+
+        proto = TensorFrame.from_dict({"x": np.zeros(4, np.float32)})
+        xi = tfs.block(proto, "x", tf_name="x_input")
+        fetch = dsl.reduce_mean(xi, axes=[0]).named("x")
+        ref = float(_stream_ref(4).mean())
+        with config.override(
+            block_scheduler="global", plan_pipeline=True,
+            global_frame_min_rows=1,
+        ):
+            out = tfs.reduce_blocks_stream(
+                fetch, _stream_chunks(4), feed_dict={"x_input": "x"},
+            )
+            assert globalframe.state()["stream_folds"] == 0
+        np.testing.assert_allclose(float(np.asarray(out)), ref, rtol=1e-5)

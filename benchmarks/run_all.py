@@ -100,6 +100,12 @@ SMOKE_SIZES = {
     "GLOBAL_BLOCKS": "32",
     "GLOBAL_ITERS": "3",
     "GLOBAL_CHAIN": "8",
+    # autobatch smoke keeps MANY DISTINCT block sizes (the compile-
+    # cardinality contract, like the bucketing smoke) and tiny blocks
+    "AUTOBATCH_BLOCKS": "12",
+    "AUTOBATCH_BASE": "5",
+    "AUTOBATCH_STEP": "3",
+    "AUTOBATCH_ITERS": "2",
 }
 
 
@@ -130,9 +136,10 @@ def main():
         "overload_bench",
         "serving_bench",
         "autotune_bench",
-        # LAST FOUR: on a 1-CPU-device host these retarget the process
+        # LAST FIVE: on a 1-CPU-device host these retarget the process
         # to a virtual 8-device mesh (clear_backends), which must not
         # leak into any bench that runs before them
+        "autobatch_bench",
         "globalframe_bench",
         "scheduler_bench",
         "chaos_bench",

@@ -96,6 +96,10 @@ _ROWWISE_OPS = {
     "Add", "AddV2", "Sub", "Mul", "Div", "RealDiv", "TruncateDiv",
     "FloorDiv", "Maximum", "Minimum", "Pow", "SquaredDifference", "Mod",
     "FloorMod",
+    # elementwise predicates/selects: how per-row control-flow conditions
+    # are authored — row-local like any other elementwise op
+    "Greater", "GreaterEqual", "Less", "LessEqual", "Equal", "NotEqual",
+    "LogicalAnd", "LogicalOr", "LogicalNot", "Select", "SelectV2",
 }
 
 
@@ -109,11 +113,22 @@ def _rowwise_transform(graph: Graph, roots, ph_rank) -> bool:
     a lead-rank constant broadcasts along the row axis, so sliced/padded
     feeds would mismatch it. One implementation so map-bucketing
     eligibility can never silently diverge from reduce-chunk
-    eligibility."""
+    eligibility.
+
+    Functionalized control flow (`_Cond`/`_While`) is deferred, not
+    rejected: once the lead rank is known, `graph.vectorize` re-runs
+    this walk over each branch/cond/body subgraph at that rank — a
+    control node whose subgraphs are row-local lowers to a masked dense
+    program (cond -> select, while -> convergence-masked fixed point)
+    and is therefore row-local itself. Gated on `config.row_vectorize`;
+    rejections are counted by reason for diagnostics."""
+    from .graph import vectorize as _vec
+
     seen: set = set()
     stack = [_base(r) for r in roots]
     const_shapes: List[tuple] = []
     ranks: set = set()
+    control_nodes: List = []
     while stack:
         name = stack.pop()
         if name in seen:
@@ -134,6 +149,13 @@ def _rowwise_transform(graph: Graph, roots, ph_rank) -> bool:
                 tuple(node.attrs["value"].value.to_numpy().shape)
             )
             continue
+        if node.op in _vec.CONTROL_OPS:
+            # verdict needs the lead rank — defer until it is resolved,
+            # but keep walking the node's own inputs (pred, loop vars,
+            # captures must all be row-local too)
+            control_nodes.append(node)
+            stack.extend(src for src, _ in node.data_inputs())
+            continue
         if node.op not in _ROWWISE_OPS:
             return False
         stack.extend(src for src, _ in node.data_inputs())
@@ -144,6 +166,9 @@ def _rowwise_transform(graph: Graph, roots, ph_rank) -> bool:
         if len(cs) > lead_rank or (
             len(cs) == lead_rank and cs and cs[0] != 1
         ):
+            return False
+    for node in control_nodes:
+        if not _vec.subgraphs_row_local(graph, node, lead_rank):
             return False
     return True
 

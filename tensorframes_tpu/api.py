@@ -1203,9 +1203,21 @@ def map_rows(
         # `_concat_parts` below concatenates ON DEVICE (colocating
         # cross-device parts), so a chained verb never pays a hidden
         # per-block D2H sync
+        from . import shape_policy as _sp
+        from .graph import vectorize as _vec
         from .runtime import faults as _flt
         from .runtime import scheduler as _rs
         from .utils import telemetry as _tele
+
+        # Bucketed vmapped dispatch (`graph/vectorize.py` companion):
+        # the vmapped per-row program is row-independent by
+        # construction, so padding a block up the bucket ladder and
+        # slicing the pad rows off is always sound — drifting block
+        # sizes (and the branchy per-row graphs the vectorizer just
+        # unlocked) compile O(log max-rows) specializations instead of
+        # one per distinct size. Bindings keep the exact per-shape
+        # dispatch (bound feeds must stay whole).
+        bucketed = not bindings and _sp.enabled(ex) and _vec.enabled()
 
         sched = _rs.schedule_for(frame, devices=devices, executor=ex)
         fscope = _flt.scope("map_rows")
@@ -1222,6 +1234,9 @@ def map_rows(
                 else frame.column(mapping[p]).values[lo_:hi_]
                 for p in params
             ]
+            bucket = hi_ - lo_
+            if bucketed:
+                feeds, bucket = _sp.pad_feeds(feeds, hi_ - lo_)
 
             def _thunk():
                 # per-attempt span (see map_blocks._dispatch_rows)
@@ -1229,12 +1244,14 @@ def map_rows(
                 with _tele.dispatch_span(
                     "map_rows.block", program=fp, block=bi,
                     rows=hi_ - lo_,
+                    bucket=bucket if bucketed else None,
                     device=sched.label(bi) if sched is not None else None,
                 ):
                     return call(*feeds)
 
             try:
-                return _thunk_outs(_thunk, bi, lo_, hi_)
+                outs_ = _thunk_outs(_thunk, bi, lo_, hi_)
+                return _sp.slice_pad_rows(outs_, hi_ - lo_, bucket)
             except Exception as e:
                 if _flt.classify(e) != _flt.RESOURCE:
                     raise

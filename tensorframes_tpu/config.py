@@ -292,6 +292,22 @@ class Config:
             minimum=0,
         )
     )
+    # Auto-batched per-row control flow (`graph/vectorize.py`): graphs
+    # containing functionalized `_Cond`/`_While` whose branch/body
+    # subgraphs are row-local classify as row-local themselves and lower
+    # to masked dense programs (cond -> both-branches + select on the
+    # batched predicate, while -> convergence-masked fixed point), so
+    # branchy per-row graphs ride the bucket ladder, serving batcher and
+    # the GlobalFrame one-dispatch SPMD path instead of falling back to
+    # unbatched execution. Off = the historical conservative classifier
+    # (any control-flow node disqualifies the graph) and scalar-pred-only
+    # lowering. Env override TFS_ROW_VECTORIZE ("0" disables) seeds the
+    # initial value.
+    row_vectorize: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_ROW_VECTORIZE", True, "row_vectorize"
+        )
+    )
     # Pipelined ingest (`ingest.pipeline`): stream verbs and the io
     # readers run shard discovery -> parallel decode -> H2D transfer ->
     # compute as concurrently-executing stages over bounded queues.

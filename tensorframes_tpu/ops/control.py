@@ -14,6 +14,8 @@ whole op registry work inside bodies for free.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -35,18 +37,32 @@ def _sub(ctx, node, attr_key):
 @register("_Cond")
 def _cond(ctx, node, inputs):
     from .lowering import build_callable
+    from ..graph import vectorize as _vec
 
     tsub = _sub(ctx, node, "cond_then")
     esub = _sub(ctx, node, "cond_else")
     tfn = build_callable(tsub.graph, tsub.fetches, tsub.feeds)
     efn = build_callable(esub.graph, esub.fetches, esub.feeds)
     pred, *operands = inputs
-    pred = jnp.reshape(jnp.asarray(pred).astype(bool), ())
+    pred = jnp.asarray(pred)
+    operands = tuple(jnp.asarray(v) for v in operands)
+    if pred.size != 1:
+        # batched predicate: the per-row graph is executing at block
+        # level, so the cond selects per row — evaluate both (pure)
+        # branches and mask (graph/vectorize.py)
+        if not _vec.enabled():
+            raise GraphLoweringError(
+                f"_Cond (node {node.name!r}) has a batched predicate of "
+                f"shape {pred.shape} but row vectorization is disabled "
+                "(config.row_vectorize / TFS_ROW_VECTORIZE)"
+            )
+        return _vec.select_cond(node, pred, tfn(*operands), efn(*operands))
+    _vec.check_branch_avals(node, tfn, efn, operands)
     out = lax.cond(
-        pred,
+        jnp.reshape(pred.astype(bool), ()),
         lambda ops: tuple(tfn(*ops)),
         lambda ops: tuple(efn(*ops)),
-        tuple(jnp.asarray(v) for v in operands),
+        operands,
     )
     return tuple(out)
 
@@ -111,7 +127,10 @@ def _tl_length(ctx, node, inputs):
 
 @register("_While")
 def _while(ctx, node, inputs):
+    import jax
+
     from .lowering import build_callable
+    from ..graph import vectorize as _vec
 
     csub = _sub(ctx, node, "while_cond")
     bsub = _sub(ctx, node, "while_body")
@@ -119,6 +138,23 @@ def _while(ctx, node, inputs):
     cond_fn = build_callable(csub.graph, csub.fetches, csub.feeds)
     body_fn = build_callable(bsub.graph, bsub.fetches, bsub.feeds)
     carry = tuple(jnp.asarray(v) for v in inputs)
+    pred0 = jax.eval_shape(
+        lambda *c: jnp.asarray(cond_fn(*c)[0]), *carry
+    )
+    if math.prod(pred0.shape) != 1:
+        # batched predicate: the per-row loop is executing at block
+        # level — lower to ONE convergence-masked dense fixed point
+        # (graph/vectorize.py) instead of failing the scalar reshape
+        if not _vec.enabled():
+            raise GraphLoweringError(
+                f"_While (node {node.name!r}) has a batched predicate "
+                f"of shape {pred0.shape} but row vectorization is "
+                "disabled (config.row_vectorize / TFS_ROW_VECTORIZE)"
+            )
+        return _vec.masked_while(
+            node, carry, n_vars, cond_fn, body_fn, pred0
+        )
+    _vec.check_while_carry(node, body_fn, carry, n_vars)
     out = lax.while_loop(
         lambda c: jnp.reshape(cond_fn(*c)[0], ()).astype(bool),
         lambda c: tuple(body_fn(*c)),

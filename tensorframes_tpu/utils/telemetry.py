@@ -951,6 +951,12 @@ _PROM_HELP: Dict[str, str] = {
     "global_stream_folds": (
         "Eager double-buffer folds on global streaming reduces"
     ),
+    "row_vectorize_lowered": (
+        "Control-flow nodes lowered to masked dense programs, by kind"
+    ),
+    "row_vectorize_fallbacks": (
+        "Graphs kept off the vectorized control-flow path, by reason"
+    ),
     "materialize_hits": "Materialization-cache hits served without compute",
     "materialize_misses": "Materialization-cache lookups that missed",
     "materialize_evictions": "Materialization-cache entries evicted (LRU)",
@@ -1214,6 +1220,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["globalframe"] = _globalframe.state()
     except Exception as e:
         data["globalframe"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # row vectorization: masked-dense control-flow accounting ------------
+    try:
+        from ..graph import vectorize as _vectorize
+
+        data["row_vectorize"] = _vectorize.state()
+    except Exception as e:
+        data["row_vectorize"] = {"error": f"{type(e).__name__}: {e}"}
 
     # materialization cache: hit/store/eviction accounting ---------------
     try:
@@ -1572,6 +1586,22 @@ def _render_diagnostics(data: Dict) -> str:
                 f"  streaming double-buffer: {gf['stream_folds']} eager "
                 "fold(s) overlapped sharded H2D"
             )
+
+    # row vectorization ---------------------------------------------------
+    rv = data.get("row_vectorize", {})
+    if rv and "error" not in rv and (
+        rv.get("lowered") or rv.get("fallbacks")
+    ):
+        lines.append("")
+        low = rv.get("lowered", {})
+        lines.append(
+            "row vectorization: "
+            f"{low.get('cond', 0)} cond->select and "
+            f"{low.get('while', 0)} while->masked-fixed-point "
+            "lowering(s)"
+        )
+        for reason, n in sorted(rv.get("fallbacks", {}).items()):
+            lines.append(f"  fallback {reason}: {n} graph(s)")
 
     # materialization cache ----------------------------------------------
     mat = data.get("materialize", {})

@@ -39,6 +39,7 @@ def _reset_telemetry():
     bleed into the next test's scheduling."""
     yield
     from tensorframes_tpu import config, globalframe, serving
+    from tensorframes_tpu.graph import vectorize
     from tensorframes_tpu.runtime import (
         autotune,
         checkpoint,
@@ -61,3 +62,4 @@ def _reset_telemetry():
     checkpoint.reset_state()  # durable-stream accounting never leaks
     globalframe.reset_state()  # SPMD dispatch/fallback ledger never leaks
     materialize.reset_state()  # cached results never answer another test
+    vectorize.reset_state()  # lowering/fallback ledger never leaks

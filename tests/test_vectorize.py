@@ -1,0 +1,477 @@
+"""Auto-batched per-row control flow (ISSUE 18): `_Cond`/`_While`
+lowered to masked dense programs (`graph/vectorize.py`).
+
+The acceptance contracts under test:
+
+- A branchy per-row graph (TF cond + data-dependent-trip-count while)
+  classifies row-local; the masked lowerings are bit-identical to the
+  unbatched per-row path across divergent branch takes and ragged trip
+  counts — including all-rows-converged-immediately and max-trip rows.
+- Non-row-local branches/carries fall back unbatched, counted by
+  reason in `vectorize.state()` and the always-live counters.
+- Shape/dtype drift raises a typed `GraphLoweringError` NAMING the
+  offending carry / branch output instead of an XLA trace error.
+- A branchy map on a GlobalFrame executes as exactly ONE SPMD dispatch
+  span (``sharding=data:N``) instead of falling back.
+- A branchy serving endpoint proves batchable, warm-compiles the full
+  bucket ladder, and serves steady-state traffic with ZERO compiles.
+- ``TFS_ROW_VECTORIZE`` seeds `config.row_vectorize` in a fresh
+  interpreter; the knob-off path stays available and loud.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import config, globalframe
+from tensorframes_tpu import shape_policy as sp
+from tensorframes_tpu.graph import vectorize
+from tensorframes_tpu.graph.control_flow import functionalize
+from tensorframes_tpu.graph.ir import Graph
+from tensorframes_tpu.ops.registry import GraphLoweringError
+from tensorframes_tpu.runtime.executor import default_executor
+from tensorframes_tpu.serving import batcher as serve_batcher
+from tensorframes_tpu.utils import telemetry
+
+tf_mod = pytest.importorskip("tensorflow")
+tf = tf_mod
+tf1 = tf_mod.compat.v1
+
+NDEV = len(jax.local_devices())
+
+
+def _branchy_bytes() -> bytes:
+    """Per-row: cond ``x>0 ? 2x : x-5`` plus a ragged-trip while that
+    halves x until ``|v| <= 1`` (counting trips). The canonical branchy
+    workload: divergent branch takes AND data-dependent trip counts."""
+    g = tf1.Graph()
+    with g.as_default():
+        x = tf1.placeholder(tf.float32, shape=(), name="x")
+        c = tf.cond(x > 0.0, lambda: x * 2.0, lambda: x - 5.0)
+
+        def body(v, k):
+            return v * 0.5, k + 1
+
+        v_f, k_f = tf.while_loop(
+            lambda v, k: tf.abs(v) > 1.0, body, [x, tf.constant(0)]
+        )
+        tf.identity(c + v_f, name="out")
+        tf.identity(k_f, name="trips")
+    return g.as_graph_def().SerializeToString()
+
+
+def _ref(xv):
+    """Per-row numpy reference for `_branchy_bytes` (float32 halving
+    matches the compiled program bit-for-bit: 0.5 is exact)."""
+    c = np.where(xv > 0, xv * 2.0, xv - 5.0).astype(np.float32)
+    v = xv.copy()
+    k = np.zeros(len(xv), np.int32)
+    for i in range(len(xv)):
+        while abs(v[i]) > 1.0:
+            v[i] *= np.float32(0.5)
+            k[i] += 1
+    return c + v, k
+
+
+#: Divergent branch takes, a zero-trip row (0.5), a max-trip row
+#: (-300 needs 9 halvings), and the boundary row 0.0.
+_X = np.array([2.0, -1.0, 0.5, -300.0, 0.0, 77.0, 8.0], dtype=np.float32)
+
+
+def _lifted() -> Graph:
+    return vectorize.lift_to_block_level(Graph.from_bytes(_branchy_bytes()))
+
+
+def _classify(data: bytes, fetches=("out", "trips")) -> bool:
+    g, f = functionalize(Graph.from_bytes(data), list(fetches))
+    return sp.rowwise_fetches(g, f, {"x": 1})
+
+
+def _drift_frame(sizes, seed=0):
+    rng = np.random.RandomState(seed)
+    base = (rng.rand(sum(sizes)).astype(np.float32) - 0.5) * 40.0
+    offsets = list(np.cumsum([0] + list(sizes)))
+    proto = tfs.TensorFrame.from_dict({"x": base})
+    return tfs.TensorFrame([proto["x"]], offsets), base
+
+
+def _dispatches():
+    return [s for s in telemetry.spans() if s.kind == "dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_branchy_graph_is_row_local(self):
+        assert _classify(_branchy_bytes())
+        assert vectorize.state()["fallbacks"] == {}
+
+    def test_disabled_counts_fallback(self):
+        with config.override(row_vectorize=False):
+            vectorize.reset_state()
+            assert not _classify(_branchy_bytes())
+        assert vectorize.state()["fallbacks"] == {"disabled": 1}
+
+    def test_non_row_local_cond_branch_falls_back(self):
+        # tf.stack (Pack) is outside the conservative row-local op set:
+        # the branch mixes rows, so the cond must stay unbatched
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, shape=(), name="x")
+            y = tf.cond(
+                x > 0.0,
+                lambda: tf.reduce_sum(tf.stack([x, x])),
+                lambda: x,
+            )
+            tf.identity(y, name="y")
+        data = g.as_graph_def().SerializeToString()
+        assert not _classify(data, fetches=("y",))
+        assert (
+            vectorize.state()["fallbacks"].get("cond-branch-not-row-local")
+            == 1
+        )
+
+    def test_non_row_local_while_body_falls_back(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, shape=(), name="x")
+            out = tf.while_loop(
+                lambda v: v < 10.0,
+                lambda v: tf.reduce_sum(tf.stack([v, v])),
+                [x],
+            )
+            tf.identity(out[0], name="y")
+        data = g.as_graph_def().SerializeToString()
+        assert not _classify(data, fetches=("y",))
+        assert (
+            vectorize.state()["fallbacks"].get("while-body-not-row-local")
+            == 1
+        )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: masked dense lowerings vs the unbatched per-row path
+# ---------------------------------------------------------------------------
+
+
+class TestBitIdentity:
+    def test_map_rows_matches_per_row_reference(self):
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        out = tfs.map_rows(
+            _branchy_bytes(), df, fetch_names=["out", "trips"]
+        )
+        want_out, want_trips = _ref(_X)
+        assert np.array_equal(out["out"].values, want_out)
+        assert np.array_equal(out["trips"].values, want_trips)
+
+    def test_vectorized_matches_unbatched_path_bitwise(self):
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        on = tfs.map_rows(_branchy_bytes(), df, fetch_names=["out", "trips"])
+        with config.override(row_vectorize=False):
+            off = tfs.map_rows(
+                _branchy_bytes(), df, fetch_names=["out", "trips"]
+            )
+        assert np.array_equal(on["out"].values, off["out"].values)
+        assert np.array_equal(on["trips"].values, off["trips"].values)
+
+    def test_lifted_map_blocks_matches_reference(self):
+        # block-level branchy program (the thing TF cannot author):
+        # the lifted predicate carries the row axis, so `_Cond` lowers
+        # to select and `_While` to ONE convergence-masked fixed point
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        out = tfs.map_blocks(_lifted(), df, fetch_names=["out", "trips"])
+        want_out, want_trips = _ref(_X)
+        assert np.array_equal(out["out"].values, want_out)
+        assert np.array_equal(out["trips"].values, want_trips)
+        low = vectorize.state()["lowered"]
+        assert low.get("cond", 0) >= 1 and low.get("while", 0) >= 1
+
+    def test_all_rows_converged_immediately(self):
+        x = np.array([0.5, -0.1, 0.0], np.float32)
+        df = tfs.TensorFrame.from_dict({"x": x})
+        out = tfs.map_blocks(_lifted(), df, fetch_names=["out", "trips"])
+        want_out, want_trips = _ref(x)
+        assert np.array_equal(out["trips"].values, np.zeros(3, np.int32))
+        assert np.array_equal(out["out"].values, want_out)
+        assert np.array_equal(out["trips"].values, want_trips)
+
+    def test_bucketed_dispatch_bounds_compiles(self):
+        # drifting block sizes ride the bucket ladder: O(log max-rows)
+        # specializations instead of one per distinct size — and every
+        # dispatch span is stamped with its bucket like map_blocks
+        sizes = [3, 5, 7, 9, 11, 13, 15, 17]
+        df, base = _drift_frame(sizes)
+        want_out, want_trips = _ref(base)
+        ex = default_executor()
+        data = _branchy_bytes()
+        # pin to one device: the compile counter counts per-device
+        # executables, which would mask the ladder effect on the
+        # 8-device test mesh
+        dev = jax.local_devices()[:1]
+
+        with config.override(row_vectorize=False):
+            c0 = ex.jit_shape_compiles()
+            off = tfs.map_rows(
+                data, df, fetch_names=["out", "trips"], devices=dev
+            )
+            off_compiles = ex.jit_shape_compiles() - c0
+        assert np.array_equal(off["out"].values, want_out)
+
+        telemetry.reset()
+        c0 = ex.jit_shape_compiles()
+        on = tfs.map_rows(
+            data, df, fetch_names=["out", "trips"], devices=dev
+        )
+        on_compiles = ex.jit_shape_compiles() - c0
+        assert np.array_equal(on["out"].values, want_out)
+        assert np.array_equal(on["trips"].values, want_trips)
+        # 8 distinct sizes off the ladder vs the ladder bound on it
+        assert off_compiles == len(sizes)
+        assert on_compiles < off_compiles
+        assert on_compiles <= len(sp.bucket_ladder(max(sizes)))
+        spans = [s for s in _dispatches() if s.name == "map_rows.block"]
+        assert len(spans) == len(sizes)
+        for s in spans:
+            attrs = dict(s.attrs)
+            assert attrs["bucket"] >= attrs["rows"]
+
+
+# ---------------------------------------------------------------------------
+# typed errors: drift is diagnosed by name, not by XLA trace dump
+# ---------------------------------------------------------------------------
+
+
+class TestTypedErrors:
+    def test_while_carry_drift_names_carry(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, shape=(2,), name="x")
+            out = tf.while_loop(
+                lambda v: tf.shape(v)[0] < 8,
+                lambda v: tf.concat([v, v], axis=0),
+                [x],
+                shape_invariants=[tf.TensorShape([None])],
+            )
+            tf.identity(out[0], name="y")
+        data = g.as_graph_def().SerializeToString()
+        df = tfs.TensorFrame.from_dict(
+            {"x": np.ones((1, 2), np.float32)}
+        )
+        with pytest.raises(GraphLoweringError, match="drifts from"):
+            tfs.map_rows(data, df, fetch_names=["y"])
+
+    def test_cond_branch_mismatch_names_output(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, shape=(), name="x")
+            y = tf.cond(
+                x > 0.0,
+                lambda: tf.zeros([2]),
+                lambda: tf.zeros([3]),
+            )
+            tf.identity(y, name="y")
+        data = g.as_graph_def().SerializeToString()
+        df = tfs.TensorFrame.from_dict({"x": np.ones(3, np.float32)})
+        with pytest.raises(GraphLoweringError, match="then-branch"):
+            tfs.map_rows(data, df, fetch_names=["y"])
+
+    def test_batched_pred_with_knob_off_is_loud(self):
+        # a block-level branchy program cannot execute without the
+        # vectorizer; the refusal must name the knob, not fail deep in
+        # a scalar reshape
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        g = _lifted()
+        with config.override(row_vectorize=False):
+            with pytest.raises(
+                GraphLoweringError, match="row vectorization is disabled"
+            ):
+                tfs.map_blocks(g, df, fetch_names=["out", "trips"])
+
+
+# ---------------------------------------------------------------------------
+# GlobalFrame: branchy maps ride the one-dispatch SPMD path
+# ---------------------------------------------------------------------------
+
+
+class TestGlobalFrameRoute:
+    def _x(self, n=64, seed=3):
+        rng = np.random.RandomState(seed)
+        return ((rng.rand(n).astype(np.float32) - 0.5) * 40.0)
+
+    def test_branchy_map_rows_is_one_spmd_dispatch(self):
+        x = self._x()
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=4)
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            out = tfs.map_rows(
+                _branchy_bytes(), df, fetch_names=["out", "trips"]
+            )
+        spans = _dispatches()
+        assert len(spans) == 1
+        assert spans[0].name == "map_rows.global"
+        assert dict(spans[0].attrs)["sharding"] == f"data:{NDEV}"
+        assert globalframe.state()["fallbacks"] == {}
+        want_out, want_trips = _ref(x)
+        assert np.array_equal(out["out"].values, want_out)
+        assert np.array_equal(out["trips"].values, want_trips)
+
+    def test_lifted_map_blocks_is_one_spmd_dispatch(self):
+        x = self._x(seed=4)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=4)
+        g = _lifted()
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global", global_frame_min_rows=1
+        ):
+            out = tfs.map_blocks(g, df, fetch_names=["out", "trips"])
+        spans = _dispatches()
+        assert len(spans) == 1
+        assert spans[0].name == "map_blocks.global"
+        assert dict(spans[0].attrs)["sharding"] == f"data:{NDEV}"
+        assert globalframe.state()["fallbacks"] == {}
+        want_out, _ = _ref(x)
+        assert np.array_equal(out["out"].values, want_out)
+
+    def test_knob_off_branchy_map_blocks_stays_loud(self):
+        # regression guard: with the vectorizer off, the global router
+        # skips cleanly (its probe cannot analyze the batched-pred
+        # program) and the EAGER path raises the typed knob-naming
+        # error — no crash inside the router, no misleading fallback
+        x = self._x(seed=5)
+        df = tfs.TensorFrame.from_dict({"x": x}, num_blocks=4)
+        g = _lifted()
+        globalframe.reset_state()
+        with config.override(
+            block_scheduler="global",
+            global_frame_min_rows=1,
+            row_vectorize=False,
+        ):
+            with pytest.raises(
+                GraphLoweringError, match="row vectorization is disabled"
+            ):
+                tfs.map_blocks(g, df, fetch_names=["out", "trips"])
+        assert globalframe.state()["fallbacks"] == {}
+
+
+# ---------------------------------------------------------------------------
+# serving: branchy endpoints batch like elementwise ones
+# ---------------------------------------------------------------------------
+
+
+class TestServing:
+    def test_branchy_endpoint_batchable_zero_steady_compiles(self):
+        ep = tfs.serving.register(
+            "branchy",
+            _lifted(),
+            {"x": "float32"},
+            fetch_names=["out", "trips"],
+            max_batch_rows=64,
+        )
+        assert ep.batchable
+        assert list(ep.warmed_rungs) == sp.bucket_ladder(64)
+        ex = default_executor()
+        base = ex.jit_shape_compiles()
+        for n in (1, 5, 17, 64):
+            rng = np.random.RandomState(n)
+            x = ((rng.rand(n).astype(np.float32) - 0.5) * 40.0)
+            req = tfs.TensorFrame.from_dict({"x": x})
+            want_out, want_trips = _ref(x)
+            direct = ep.run_frame(req)
+            assert np.array_equal(
+                direct.column("out").host_values(), want_out
+            )
+            assert np.array_equal(
+                direct.column("trips").host_values(), want_trips
+            )
+            batched = serve_batcher().submit(ep, req).result(timeout=30)
+            assert np.array_equal(
+                batched.column("out").host_values(), want_out
+            )
+        assert ex.jit_shape_compiles() == base
+
+
+# ---------------------------------------------------------------------------
+# lazy plans: branchy stages still fuse
+# ---------------------------------------------------------------------------
+
+
+class TestLazy:
+    def test_branchy_lazy_plan_forces_bit_identical(self):
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        lz = tfs.map_blocks(
+            _lifted(), df.lazy(), fetch_names=["out", "trips"]
+        )
+        out = lz.force()
+        want_out, want_trips = _ref(_X)
+        assert np.array_equal(out["out"].values, want_out)
+        assert np.array_equal(out["trips"].values, want_trips)
+
+
+# ---------------------------------------------------------------------------
+# env knob + diagnostics
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnob:
+    def _probe(self, env):
+        code = (
+            "from tensorframes_tpu import config\n"
+            "c = config.get()\n"
+            "import json\n"
+            "print(json.dumps({\n"
+            "  'row_vectorize': c.row_vectorize,\n"
+            "  'explicit': sorted(config.explicit_keys()),\n"
+            "}))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **env},
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    def test_default_on(self):
+        got = self._probe({})
+        assert got["row_vectorize"] is True
+        assert "row_vectorize" not in got["explicit"]
+
+    def test_env_disables_and_pins(self):
+        got = self._probe({"TFS_ROW_VECTORIZE": "0"})
+        assert got["row_vectorize"] is False
+        assert "row_vectorize" in got["explicit"]
+
+
+class TestDiagnostics:
+    def test_row_vectorization_section(self):
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        tfs.map_blocks(_lifted(), df, fetch_names=["out", "trips"])
+        with config.override(row_vectorize=False):
+            assert not _classify(_branchy_bytes())
+        data = telemetry.diagnostics(format="json")
+        rv = data["row_vectorize"]
+        assert rv["lowered"].get("cond", 0) >= 1
+        assert rv["lowered"].get("while", 0) >= 1
+        assert rv["fallbacks"] == {"disabled": 1}
+        text = telemetry.diagnostics(format="text")
+        assert "row vectorization" in text
+        assert "fallback disabled" in text
+
+    def test_counters_export_with_help(self):
+        df = tfs.TensorFrame.from_dict({"x": _X})
+        tfs.map_blocks(_lifted(), df, fetch_names=["out", "trips"])
+        text = telemetry.export_prometheus()
+        assert "row_vectorize_lowered" in text
+        assert 'kind="while"' in text

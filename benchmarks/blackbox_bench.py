@@ -1,0 +1,149 @@
+"""Flight-recorder bench: the incident-capture acceptance harness
+(ISSUE 19).
+
+Two contracts, asserted:
+
+1. **Armed costs nothing fault-free.** The recorder is always armed by
+   default, and capture only runs on fault paths — so a fault-free
+   chained lazy map→reduce with the recorder armed must stay within 1%
+   (plus a small absolute floor for timer noise at smoke sizes) of the
+   same loop with ``incident_capture=False``. Iterations interleave
+   so drift (thermal, cache) hits both arms equally.
+
+2. **A deadline storm captures fast and bounded.** A burst of verbs
+   wedged by injected hangs and killed by tiny budgets — with dedup
+   disabled so EVERY fault writes a bundle — must leave one bundle per
+   fault, mean capture latency under one backoff quantum (capture must
+   not meaningfully extend the fault path's overshoot bound), and the
+   store pruned under its budgets.
+
+Sizes: BLACKBOX_ROWS (1_000_000), BLACKBOX_BLOCKS (8), BLACKBOX_ITERS
+(20), BLACKBOX_STORM (6).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benchmarks._util import emit, scaled  # noqa: E402
+
+
+def main():
+    import tensorframes_tpu as tfs
+    from tensorframes_tpu import config, dsl
+    from tensorframes_tpu.frame import TensorFrame
+    from tensorframes_tpu.runtime import blackbox
+    from tensorframes_tpu.runtime import deadline as dl
+    from tensorframes_tpu.testing import faults as chaos
+    from tensorframes_tpu.utils import telemetry
+
+    rows = scaled("BLACKBOX_ROWS", 1_000_000)
+    blocks = scaled("BLACKBOX_BLOCKS", 8)
+    iters = scaled("BLACKBOX_ITERS", 20)
+    storm = scaled("BLACKBOX_STORM", 6)
+
+    rng = np.random.RandomState(0)
+    df = TensorFrame.from_dict(
+        {"x": rng.rand(rows).astype(np.float32)}, num_blocks=blocks
+    ).to_device()
+
+    def chain(**kw):
+        lz = df.lazy().map_blocks(
+            (tfs.block(df, "x") * 2.0 + 1.0).named("y")
+        )
+        fetch = dsl.reduce_sum(
+            tfs.block(lz, "y", tf_name="y_input"), axes=[0]
+        ).named("y")
+        return float(np.asarray(tfs.reduce_blocks(fetch, lz, **kw)))
+
+    # ---- armed-vs-disarmed overhead, fault-free ----------------------
+    ref = chain()  # warm the compile cache
+    armed_lat, off_lat = [], []
+    for _ in range(iters):  # interleaved: drift hits both arms equally
+        t0 = time.perf_counter()
+        out = chain()
+        armed_lat.append(time.perf_counter() - t0)
+        assert out == ref, "armed result drifted"
+        with config.override(incident_capture=False):
+            t0 = time.perf_counter()
+            out = chain()
+            off_lat.append(time.perf_counter() - t0)
+        assert out == ref, "disarmed result drifted"
+    armed_med = float(np.median(armed_lat))
+    off_med = float(np.median(off_lat))
+    overhead = armed_med / max(off_med, 1e-12) - 1.0
+    # ≤1% of the baseline, plus an absolute floor for timer noise on
+    # millisecond-scale smoke runs
+    bound = max(0.01 * off_med, 0.002)
+    assert armed_med - off_med <= bound, (
+        f"armed fault-free overhead {overhead * 100:.2f}% "
+        f"({(armed_med - off_med) * 1e3:.3f}ms) exceeds 1% bound "
+        f"(armed {armed_med * 1e3:.3f}ms vs off {off_med * 1e3:.3f}ms)"
+    )
+    assert blackbox.state()["captured"] == 0, (
+        "a fault-free run captured an incident"
+    )
+    emit("blackbox_armed_med", armed_med * 1e3, "ms")
+    emit("blackbox_disarmed_med", off_med * 1e3, "ms")
+    emit("blackbox_overhead", overhead * 100.0, "%")
+
+    # ---- deadline storm: every fault bundles, capture stays fast -----
+    incident_dir = tempfile.mkdtemp(prefix="tfs-blackbox-bench-")
+    try:
+        telemetry.reset()
+        blackbox.reset_state()
+        with config.override(
+            incident_dir=incident_dir,
+            incident_rate_limit_s=0.0,  # every fault writes: worst case
+        ):
+            hits = 0
+            t0 = time.perf_counter()
+            with chaos.inject(rate=1.0, seed=1, fault="hang", delay_s=30.0):
+                for _ in range(storm):
+                    try:
+                        chain(timeout_s=0.05)
+                    except tfs.DeadlineExceeded:
+                        hits += 1
+            storm_wall = time.perf_counter() - t0
+            assert hits == storm, f"{hits}/{storm} deadlines fired"
+            bundles = tfs.incidents()
+            assert len(bundles) == storm, (
+                f"{len(bundles)} bundle(s) for {storm} fault(s) with "
+                "dedup disabled"
+            )
+        st = blackbox.state()
+        assert st["captured"] == storm
+        _c, _g, hists = telemetry.metrics_snapshot()
+        cap = hists.get(("incident_capture_seconds", ()))
+        assert cap is not None, "no capture-latency observations"
+        _buckets, _counts, cap_sum, cap_count = cap
+        assert cap_count == storm
+        mean_capture = cap_sum / cap_count
+        quantum = float(config.get().retry_backoff_max_s)
+        assert mean_capture < quantum, (
+            f"mean capture latency {mean_capture * 1e3:.1f}ms exceeds "
+            f"one backoff quantum {quantum * 1e3:.0f}ms — capture is "
+            "extending the fault path"
+        )
+        assert dl.controller().in_flight_now() == 0, "stuck admission slot"
+        emit("blackbox_storm_wall", storm_wall, "s")
+        emit("blackbox_capture_mean", mean_capture * 1e3, "ms")
+        emit("blackbox_storm_bundles", float(len(bundles)), "bundles")
+        emit("blackbox_store_bytes", float(st["bytes"]), "bytes")
+    finally:
+        blackbox.reset_state()
+        shutil.rmtree(incident_dir, ignore_errors=True)
+
+    # and the runtime is healthy afterwards: one clean call
+    assert chain() == ref, "post-storm verb is not bit-identical"
+
+
+if __name__ == "__main__":
+    main()

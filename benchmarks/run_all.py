@@ -77,6 +77,10 @@ SMOKE_SIZES = {
     "OVERLOAD_BLOCKS": "4",
     "OVERLOAD_CALLS": "6",
     "OVERLOAD_STORM": "3",
+    "BLACKBOX_ROWS": "100000",
+    "BLACKBOX_BLOCKS": "4",
+    "BLACKBOX_ITERS": "6",
+    "BLACKBOX_STORM": "3",
     "SERVE_ROWS": "512",
     "SERVE_CALLS": "24",
     "SERVE_CLIENTS": "4",
@@ -134,6 +138,7 @@ def main():
         "plan_pipeline_bench",
         "checkpoint_bench",
         "overload_bench",
+        "blackbox_bench",
         "serving_bench",
         "autotune_bench",
         # LAST FIVE: on a 1-CPU-device host these retarget the process

@@ -79,6 +79,10 @@ from .runtime import profiler as profile
 from .runtime.autotune import autotune
 from .runtime import autotune as _autotune_mod
 
+# the incident flight recorder: importing arms the /incidents routes +
+# incident_bytes gauge; tfs.incidents() lists/loads postmortem bundles
+from .runtime.blackbox import incidents
+
 # Live telemetry endpoint auto-start: serve /metrics /healthz
 # /diagnostics /trace IFF the operator set TFS_TELEMETRY_PORT /
 # config.telemetry_port (off by default — `maybe_serve` is a no-op
@@ -137,4 +141,5 @@ __all__ = [
     "diagnostics",
     "profile",
     "autotune",
+    "incidents",
 ]

@@ -480,6 +480,76 @@ class Config:
     histogram_buckets: Optional[dict] = dataclasses.field(
         default_factory=_env_histogram_buckets
     )
+    # Flight-recorder master switch (`runtime.blackbox`): when True
+    # (the default — the recorder is always armed), a typed fault
+    # escaping the runtime (deadline, shed, eviction, OOM exhaustion,
+    # checkpoint corruption, serving 5xx) captures an incident bundle.
+    # Costs nothing fault-free: capture only runs on fault paths, and
+    # disabling turns even those into one attribute read. Env override
+    # TFS_INCIDENT_CAPTURE ("0" disables) seeds the initial value.
+    incident_capture: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_INCIDENT_CAPTURE", True, "incident_capture"
+        )
+    )
+    # Incident bundle directory (`runtime.blackbox`): where postmortem
+    # bundles are committed (CheckpointStore atomic protocol). Empty
+    # (the default) = a process-private temp directory created on first
+    # capture (bundles die with the test/process); operators set a
+    # persistent path so 3am evidence survives a restart. Env override
+    # TFS_INCIDENT_DIR seeds the initial value.
+    incident_dir: str = dataclasses.field(
+        default_factory=lambda: _env_str(
+            "TFS_INCIDENT_DIR", "", "incident_dir"
+        )
+    )
+    # Trailing evidence window (`runtime.blackbox`), seconds: a bundle
+    # keeps only span-ring events that overlap the last
+    # incident_window_s before the fault, and stamps its metric deltas
+    # with the age they actually cover. Env override
+    # TFS_INCIDENT_WINDOW_S seeds the initial value.
+    incident_window_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_INCIDENT_WINDOW_S", 60.0, "incident_window_s",
+            minimum=0.0,
+        )
+    )
+    # Incident store bundle-count budget (`runtime.blackbox`): the
+    # least-recently-written bundles are pruned to keep at most this
+    # many on disk. 0 = no count bound (bytes still bound the store).
+    # Env override TFS_INCIDENT_MAX_BUNDLES seeds the initial value.
+    incident_max_bundles: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_INCIDENT_MAX_BUNDLES", 32, "incident_max_bundles",
+            minimum=0,
+        )
+    )
+    # Incident store byte budget (`runtime.blackbox`): total on-disk
+    # bundle bytes; LRU bundles prune to stay under it, and a capture
+    # whose payload cannot fit at all degrades to a counted
+    # incidents_suppressed{reason="store"} — 0 is a real zero-byte
+    # quota (every capture suppresses; the ENOSPC degradation path),
+    # not "unlimited". Env override TFS_INCIDENT_MAX_BYTES seeds the
+    # initial value.
+    incident_max_bytes: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "TFS_INCIDENT_MAX_BYTES", 67108864, "incident_max_bytes",
+            minimum=0,
+        )
+    )
+    # Per-fingerprint incident rate limit (`runtime.blackbox`),
+    # seconds: a repeat of the same incident fingerprint (trigger x
+    # program x fault class) within this window increments
+    # incidents_suppressed{reason="rate_limit"} instead of writing —
+    # a shed storm leaves ONE bundle plus a count. 0 disables
+    # dedup (every capture writes). Env override
+    # TFS_INCIDENT_RATE_LIMIT_S seeds the initial value.
+    incident_rate_limit_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_INCIDENT_RATE_LIMIT_S", 30.0, "incident_rate_limit_s",
+            minimum=0.0,
+        )
+    )
     # Cost-model accuracy warning threshold (`runtime.costmodel
     # .residuals`): a program whose span-achieved time per dispatch is
     # more than this factor away (either direction) from the cost

@@ -590,6 +590,18 @@ def _effective_deadline(
     return mine.tightened(inherited)
 
 
+def _blackbox_capture(exc: BaseException, verb: str) -> None:
+    """Hand an escaping fault to the flight recorder; best-effort by
+    contract (the recorder itself never raises, but even its import
+    must not be able to mask the caller's typed fault)."""
+    try:
+        from . import blackbox as _blackbox
+
+        _blackbox.capture_escape(exc, verb=verb)
+    except Exception:
+        pass  # recorder failures must never replace the escaping fault
+
+
 @contextlib.contextmanager
 def verb_scope(verb: str, timeout_s: Optional[float] = None):
     """One verb call's deadline/cancellation/admission envelope.
@@ -618,11 +630,25 @@ def verb_scope(verb: str, timeout_s: Optional[float] = None):
     release = None
     atok = None
     if not _ADMITTED.get():
-        release = _controller.admit(verb, scope)
+        try:
+            release = _controller.admit(verb, scope)
+        except (OverloadError, DeadlineExceeded, Cancelled) as e:
+            # the shed/expiry escapes here with the controller lock
+            # already released — the flight-recorder hook must not run
+            # under it (TFS001: capture does file I/O)
+            _blackbox_capture(e, verb)
+            raise
         atok = _ADMITTED.set(True)
     tok = _SCOPE.set(scope)
     try:
         yield scope
+    except BaseException as e:
+        if atok is not None:
+            # the unit-of-load boundary: a typed fault crossing it is
+            # ESCAPING the runtime — the flight recorder's moment
+            # (fully stamped: _stamp_partial has already run upstream)
+            _blackbox_capture(e, verb)
+        raise
     finally:
         _SCOPE.reset(tok)
         if atok is not None:

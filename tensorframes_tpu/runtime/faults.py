@@ -253,6 +253,19 @@ def reset_ledger() -> None:
 # OOM forensics: what was resident when a dispatch ran out of memory
 # ---------------------------------------------------------------------------
 
+def _tag_fault(e: BaseException, cls: str) -> None:
+    """Stamp the final classification onto an exception about to
+    escape a `FaultScope` for good — downstream layers (the flight
+    recorder's `capture_escape`, serving's status mapping) distinguish
+    a classified runtime fault from a plain user error by this
+    attribute, and re-classifying at each layer could disagree."""
+    if getattr(e, "tfs_fault_class", None) is None:
+        try:
+            e.tfs_fault_class = cls
+        except Exception:
+            pass  # __slots__ errors refuse stamps; e still raises
+
+
 # bounded: OOMs are rare, and a flapping device must not grow an
 # unbounded evidence log — the freshest window is the useful one
 _FORENSICS_MAX = 16
@@ -312,6 +325,20 @@ def record_oom(
         _tele.counter_inc("oom_forensics", 1.0, verb=str(verb))
     except Exception:
         pass  # forensics must not worsen the failure it documents
+    if not str(decision).startswith("split"):
+        # split exhaustion / ineligibility: the resource fault is about
+        # to ESCAPE — this one-off snapshot is exactly what the flight
+        # recorder generalizes, so the full bundle rides along
+        try:
+            from . import blackbox as _blackbox
+
+            _tag_fault(error, RESOURCE)
+            _blackbox.capture(
+                "oom", error, verb=str(verb), program=str(program),
+                extra={"oom": snap},
+            )
+        except Exception:
+            pass  # the recorder must not worsen the failure either
 
 
 def forensics_snapshot() -> list:
@@ -449,6 +476,7 @@ class FaultScope:
                 if cls != TRANSIENT:
                     if cls == DETERMINISTIC:
                         _note("failfast")
+                    _tag_fault(e, cls)
                     raise
                 if attempt >= self.attempts or self.budget <= 0:
                     _log.warning(
@@ -457,6 +485,7 @@ class FaultScope:
                         what, attempt + 1, self.attempts + 1,
                         self.budget, e,
                     )
+                    _tag_fault(e, cls)
                     raise
                 attempt += 1
                 self.budget -= 1

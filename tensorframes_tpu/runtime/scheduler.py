@@ -118,6 +118,7 @@ class DeviceHealth:
             st["failures"] += 1
             st["until"] = now + st["cooldown"]
             cooldown = st["cooldown"]
+            failures = st["failures"]
         _faults.note_eviction()
         _tele.counter_inc("device_evictions", 1.0, device=label)
         get_logger("scheduler").warning(
@@ -125,6 +126,21 @@ class DeviceHealth:
             "circuit open for %.1fs (half-open probe after cooldown)",
             label, cooldown,
         )
+        try:
+            # circuit-open eviction is an incident even though no
+            # exception escapes (the work re-places); captured after
+            # self._lock is released — the recorder does file I/O
+            from . import blackbox as _blackbox
+
+            _blackbox.capture(
+                "eviction",
+                extra={
+                    "device": label, "failures": failures,
+                    "cooldown_s": cooldown,
+                },
+            )
+        except Exception:
+            pass  # the recorder must never break an eviction path
 
     def mark_success(self, label: str) -> None:
         """A successful dispatch on ``label``: closes a half-open

@@ -165,6 +165,7 @@ def _handle_run(
     except _dl.OverloadError as e:
         hdrs = dict(echo)
         hdrs["Retry-After"] = str(max(1, math.ceil(e.retry_after_s)))
+        _incident(e, name, rid, 429)
         return 429, "application/json", _error_body(
             e,
             retry_after_s=e.retry_after_s,
@@ -172,15 +173,35 @@ def _handle_run(
             limit=e.limit,
         ), hdrs
     except _dl.DeadlineExceeded as e:
+        _incident(e, name, rid, 504)
         return 504, "application/json", _error_body(
             e, budget_s=e.budget_s, elapsed_s=e.elapsed_s
         ), echo
     except _dl.Cancelled as e:
+        _incident(e, name, rid, 503)
         return 503, "application/json", _error_body(e), echo
     except ValueError as e:
         return 400, "application/json", _error_body(e), echo
     except Exception as e:
+        _incident(e, name, rid, 500)
         return 500, "application/json", _error_body(e), echo
+
+
+def _incident(e: BaseException, name: str, rid: str, status: int) -> None:
+    """Flight-recorder hook for a request mapped to an error status.
+    Faults already captured at the verb layer are stamped with
+    ``tfs_incident_id`` and dedup to the same bundle; a server-side
+    failure (batcher future, IPC encode, a fresh 504 built here) gets
+    its first capture with the serving context attached."""
+    try:
+        from ..runtime import blackbox as _blackbox
+
+        _blackbox.capture(
+            "serving", e, verb=f"serve:{name}",
+            extra={"endpoint": name, "request_id": rid, "status": status},
+        )
+    except Exception:
+        pass  # the recorder must never turn a 5xx into a crash
 
 
 def _route(method: str, path: str, headers, body: bytes):

@@ -487,6 +487,7 @@ _HISTOGRAM_FAMILIES: Dict[str, str] = {
     "serve_batch_rows": "rows",
     "serve_batch_fill": "rows",
     "checkpoint_write_seconds": "seconds",
+    "incident_capture_seconds": "seconds",
 }
 
 
@@ -732,6 +733,9 @@ def _gauge_live_device_buffers() -> float:
 
 gauge_register("executor_cache_entries", _gauge_executor_cache_entries)
 gauge_register("live_device_buffers", _gauge_live_device_buffers)
+# ring overflow was previously visible only inside explain_analyze
+# warnings and the Chrome-trace otherData blob; scrapes need it live
+gauge_register("spans_dropped", lambda: float(spans_dropped()))
 
 
 # ---------------------------------------------------------------------------
@@ -894,8 +898,18 @@ def export_chrome_trace(path: Optional[str] = None) -> Dict:
         },
     }
     if path is not None:
-        with open(path, "w") as f:
-            json.dump(obj, f)
+        # atomic commit: a scrape or incident capture racing a plain
+        # open(path, "w") would read torn JSON mid-dump
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(obj, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     return obj
 
 
@@ -994,6 +1008,13 @@ _PROM_HELP: Dict[str, str] = {
     "block_rows": "Rows per block dispatch",
     "h2d_bytes": "Host-to-device transfer bytes",
     "d2h_bytes": "Device-to-host transfer bytes",
+    "spans_dropped": "Spans evicted from the trace ring by overflow",
+    "incidents_captured": "Incident bundles written by trigger class",
+    "incidents_suppressed": (
+        "Incident captures suppressed by reason (rate_limit/store/error)"
+    ),
+    "incident_bytes": "Bytes held by on-disk incident bundles",
+    "incident_capture_seconds": "Incident bundle capture latency",
 }
 
 
@@ -1236,6 +1257,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["materialize"] = _materialize.state()
     except Exception as e:
         data["materialize"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # flight recorder: incident capture/suppression accounting -----------
+    try:
+        from ..runtime import blackbox as _blackbox
+
+        data["blackbox"] = _blackbox.state()
+    except Exception as e:
+        data["blackbox"] = {"error": f"{type(e).__name__}: {e}"}
 
     # executor + recompile-storm signal ---------------------------------
     try:
@@ -1635,6 +1664,27 @@ def _render_diagnostics(data: Dict) -> str:
                 f"  last hit: program {lh['program']} "
                 f"{_fmt_bytes(lh['bytes'])} in "
                 f"{lh['load_seconds'] * 1e3:.1f}ms"
+            )
+
+    # flight recorder ----------------------------------------------------
+    bb = data.get("blackbox", {})
+    if bb and "error" not in bb and (
+        bb.get("captured") or bb.get("suppressed") or bb.get("bundles")
+    ):
+        lines.append("")
+        lines.append(
+            f"flight recorder: {bb.get('captured', 0)} incident(s) "
+            f"captured; {bb.get('bundles', 0)} bundle(s) holding "
+            f"{_fmt_bytes(bb.get('bytes', 0))} in {bb.get('dir')}"
+        )
+        for reason, n in sorted((bb.get("suppressed") or {}).items()):
+            lines.append(f"  suppressed {reason}: {n} capture(s)")
+        last = bb.get("last")
+        if last:
+            lines.append(
+                f"  last: {last.get('id')} trigger={last.get('trigger')} "
+                f"class={last.get('fault_class')} "
+                f"verb={last.get('verb')} program={last.get('program')}"
             )
 
     # executor + recompile-storm signal ---------------------------------

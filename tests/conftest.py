@@ -42,6 +42,7 @@ def _reset_telemetry():
     from tensorframes_tpu.graph import vectorize
     from tensorframes_tpu.runtime import (
         autotune,
+        blackbox,
         checkpoint,
         costmodel,
         deadline,
@@ -63,3 +64,4 @@ def _reset_telemetry():
     globalframe.reset_state()  # SPMD dispatch/fallback ledger never leaks
     materialize.reset_state()  # cached results never answer another test
     vectorize.reset_state()  # lowering/fallback ledger never leaks
+    blackbox.reset_state()  # one test's incidents never explain another's

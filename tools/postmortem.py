@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Render an incident flight-recorder bundle as a postmortem report.
+
+Usage::
+
+    python tools/postmortem.py BUNDLE.tfsinc            # a bundle file
+    python tools/postmortem.py inc-...-XXXX             # an incident id
+    python tools/postmortem.py inc-... --incident-dir DIR
+    python tools/postmortem.py --list [--incident-dir DIR]
+    python tools/postmortem.py BUNDLE.tfsinc --json
+
+A bundle is what `runtime.blackbox` commits when a typed fault escapes
+the runtime (also served live on the telemetry server's ``/incidents``
+routes, and listed by ``tfs.incidents()``). The report renders what an
+on-call reader wants in one screen: the fault (verb, budget, partial
+progress), the offending program joined with its cost-ledger entry and
+residual, the trailing span timeline, what the counters did inside the
+evidence window, device health + admission state at fault time, the
+autotune decisions in flight, and the exact config the process ran.
+
+``--json`` emits the stored payload bytes VERBATIM (after checksum
+verification) — byte-identical to what `capture` wrote, so two
+interpreters rendering the same bundle can be compared with ``cmp``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+# script-invocation bootstrap (CI runs `python tools/postmortem.py`
+# without installing the package): the repo root precedes tools/ on
+# sys.path — same recipe as tools/profile_report.py
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _resolve(target: str, incident_dir: Optional[str]) -> str:
+    """BUNDLE_OR_ID -> a bundle file path. A path that exists wins;
+    otherwise the id is looked up under --incident-dir or the live
+    recorder directory."""
+    from tensorframes_tpu.runtime import blackbox
+
+    if os.path.isfile(target):
+        return target
+    directory = incident_dir or blackbox._dir(create=False)
+    if directory:
+        path = os.path.join(directory, target + blackbox.SUFFIX)
+        if os.path.isfile(path):
+            return path
+    raise SystemExit(
+        f"postmortem: no bundle file or incident id {target!r}"
+        + (f" under {directory!r}" if directory else "")
+    )
+
+
+def render(b: Dict) -> str:
+    lines: List[str] = []
+    head = f"incident {b.get('id')}"
+    lines.append(head)
+    lines.append("=" * len(head))
+    when = b.get("captured_unix")
+    stamp = (
+        time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime(when)) + "Z"
+        if isinstance(when, (int, float))
+        else "?"
+    )
+    lines.append(
+        f"trigger={b.get('trigger')} captured={stamp} "
+        f"fingerprint={b.get('fingerprint')} "
+        f"schema=v{b.get('bundle_schema')}"
+    )
+
+    f = b.get("fault") or {}
+    lines.append("")
+    lines.append("fault")
+    lines.append("-----")
+    lines.append(
+        f"  {f.get('type')} ({f.get('class')}) in verb "
+        f"{b.get('verb') or f.get('verb')}"
+    )
+    if f.get("message"):
+        lines.append(f"  {f['message']}")
+    if f.get("budget_s") is not None:
+        elapsed = f.get("elapsed_s")
+        lines.append(
+            f"  budget {float(f['budget_s']):.3f}s"
+            + (
+                f", elapsed {float(elapsed):.3f}s"
+                if elapsed is not None
+                else ""
+            )
+        )
+    if f.get("blocks_issued") is not None:
+        lines.append(
+            f"  partial work: {f['blocks_issued']} block(s) issued, "
+            f"{f['blocks_unissued']} unissued"
+        )
+    for k in ("retry_after_s", "queue_depth", "limit", "kind", "path"):
+        if f.get(k) is not None:
+            lines.append(f"  {k}={f[k]}")
+
+    p = b.get("program") or {}
+    if p.get("fingerprint"):
+        lines.append("")
+        lines.append("offending program")
+        lines.append("-----------------")
+        lines.append(f"  fingerprint {p['fingerprint']}")
+        cost = p.get("cost")
+        if isinstance(cost, dict):
+            row = " ".join(
+                f"{k}={cost[k]}"
+                for k in sorted(cost)
+                if isinstance(cost[k], (int, float, str))
+            )
+            if row:
+                lines.append(f"  cost ledger: {row}")
+        if p.get("residual_ratio") is not None:
+            lines.append(
+                f"  model residual: {float(p['residual_ratio']):.2f}x "
+                "(achieved vs modeled)"
+            )
+
+    tr = b.get("trace") or {}
+    events = tr.get("traceEvents") or []
+    if events:
+        other = tr.get("otherData") or {}
+        lines.append("")
+        lines.append(
+            f"timeline (last {len(events)} span(s) in the "
+            f"{other.get('window_s', '?')}s window; "
+            f"{other.get('events_outside_window', 0)} older, "
+            f"{other.get('events_over_cap', 0)} over cap, "
+            f"{other.get('spans_dropped', 0)} dropped from the ring)"
+        )
+        lines.append("-" * 8)
+        t_end = max(e.get("ts", 0) + e.get("dur", 0) for e in events)
+        for e in events[-40:]:
+            rel = (e.get("ts", 0) - t_end) / 1e6
+            dur = e.get("dur", 0) / 1e6
+            args = e.get("args") or {}
+            ctx = " ".join(
+                f"{k}={args[k]}"
+                for k in ("verb", "program", "device", "rows", "what")
+                if args.get(k) is not None
+            )
+            lines.append(
+                f"  {rel:+9.3f}s {dur:8.4f}s {e.get('cat', '?'):<9} "
+                f"{e.get('name', '?'):<28} {ctx}".rstrip()
+            )
+
+    m = b.get("metrics") or {}
+    counters = m.get("counters") or {}
+    if counters:
+        covers = m.get("covers_s")
+        lines.append("")
+        lines.append(
+            "counter deltas"
+            + (
+                f" (over the {covers:.1f}s since the previous capture)"
+                if isinstance(covers, (int, float))
+                else " (since process start)"
+            )
+        )
+        lines.append("-" * 14)
+        for k in sorted(counters):
+            lines.append(f"  {k:<52} {counters[k]:+g}")
+        for k, h in sorted((m.get("histograms") or {}).items()):
+            lines.append(
+                f"  {k:<52} +{h['count']:g} obs, +{h['sum']:g} sum"
+            )
+
+    s = b.get("scheduler") or {}
+    adm = s.get("admission") or {}
+    if adm:
+        lines.append("")
+        lines.append("admission at fault time")
+        lines.append("-" * 23)
+        lines.append(
+            "  "
+            + " ".join(f"{k}={adm[k]}" for k in sorted(adm))
+        )
+    circuits = s.get("circuits") or []
+    devices = s.get("devices") or []
+    if circuits or devices:
+        lines.append("")
+        lines.append("device health")
+        lines.append("-" * 13)
+        for row in circuits:
+            lines.append(
+                "  circuit "
+                + " ".join(f"{k}={v}" for k, v in sorted(row.items()))
+            )
+        for row in devices:
+            if isinstance(row, dict):
+                lines.append(
+                    "  "
+                    + " ".join(f"{k}={v}" for k, v in sorted(row.items()))
+                )
+
+    mem = b.get("memory")
+    if isinstance(mem, list) and mem:
+        lines.append("")
+        lines.append("memory overview")
+        lines.append("-" * 15)
+        for row in mem:
+            if not isinstance(row, dict):
+                continue
+            frag = " ".join(
+                f"{k}={_fmt_bytes(v) if 'byte' in k else v}"
+                for k, v in sorted(row.items())
+            )
+            lines.append(f"  {frag}")
+
+    at = b.get("autotune_decisions")
+    if at:
+        lines.append("")
+        lines.append(f"autotune decisions ({len(at)})")
+        lines.append("-" * 18)
+        for d in at[-10:]:
+            if isinstance(d, dict):
+                lines.append(
+                    "  "
+                    + " ".join(f"{k}={v}" for k, v in sorted(d.items()))
+                )
+            else:
+                lines.append(f"  {d}")
+
+    c = b.get("config") or {}
+    lines.append("")
+    lines.append("config")
+    lines.append("------")
+    lines.append(f"  digest {c.get('digest')}")
+    if c.get("explicit"):
+        lines.append(f"  explicit pins: {', '.join(c['explicit'])}")
+    if c.get("tuned"):
+        lines.append(
+            "  tuned: "
+            + " ".join(f"{k}={v}" for k, v in sorted(c["tuned"].items()))
+        )
+
+    extra = b.get("extra") or {}
+    if extra:
+        lines.append("")
+        lines.append("trigger context")
+        lines.append("-" * 15)
+        for k, v in sorted(extra.items()):
+            lines.append(f"  {k}={v}")
+    return "\n".join(lines)
+
+
+def _list(incident_dir: Optional[str]) -> int:
+    from tensorframes_tpu.runtime import blackbox
+
+    if incident_dir:
+        rows = []
+        for mtime, path, size in reversed(blackbox._scan(incident_dir)):
+            manifest = blackbox._peek_manifest(path) or {}
+            rows.append(
+                {
+                    "id": manifest.get("incident_id"),
+                    "trigger": manifest.get("trigger"),
+                    "fault_class": manifest.get("fault_class"),
+                    "program": manifest.get("program"),
+                    "verb": manifest.get("verb"),
+                    "bytes": size,
+                    "path": path,
+                }
+            )
+    else:
+        rows = blackbox.incidents()
+    if not rows:
+        print("no incident bundles")
+        return 0
+    for r in rows:
+        print(
+            f"{r.get('id')}  trigger={r.get('trigger')} "
+            f"class={r.get('fault_class')} verb={r.get('verb')} "
+            f"program={r.get('program')} {_fmt_bytes(r.get('bytes'))}"
+        )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "bundle", nargs="?",
+        help="bundle file path or incident id (see --list)",
+    )
+    ap.add_argument(
+        "--incident-dir", metavar="DIR",
+        help="directory to resolve incident ids in "
+        "(default: config.incident_dir / the live recorder dir)",
+    )
+    ap.add_argument(
+        "--list", action="store_true", dest="list_bundles",
+        help="list available bundles instead of rendering one",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the verified payload bytes verbatim (bit-identical "
+        "to what capture wrote)",
+    )
+    args = ap.parse_args(argv)
+
+    # imports deferred past argparse so --help never pays the jax import
+    if args.list_bundles:
+        return _list(args.incident_dir)
+    if not args.bundle:
+        ap.error("BUNDLE (file or incident id) required unless --list")
+
+    from tensorframes_tpu.runtime import blackbox
+
+    path = _resolve(args.bundle, args.incident_dir)
+    payload = blackbox.load_payload(path)
+    if args.json:
+        sys.stdout.buffer.write(payload)
+        sys.stdout.buffer.flush()
+        return 0
+    print(render(json.loads(payload.decode())))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # postmortems get piped into head/less; a closed pipe is a
+        # clean exit, not a traceback (devnull dup stops the flush-at-
+        # exit error repeating it)
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

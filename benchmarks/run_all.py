@@ -73,6 +73,12 @@ SMOKE_SIZES = {
     # contract is about compute depth, not row volume) and trims rows
     "PLANPIPE_CACHE_ROWS": "100000",
     "PLANPIPE_CACHE_DEPTH": "24",
+    # relational smoke keeps MANY ROW GROUPS per shard (the pushdown
+    # contract is about group-granular pruning, not row volume)
+    "REL_SHARDS": "4",
+    "REL_GROUPS": "8",
+    "REL_GROUP_ROWS": "10000",
+    "REL_ITERS": "2",
     "OVERLOAD_ROWS": "100000",
     "OVERLOAD_BLOCKS": "4",
     "OVERLOAD_CALLS": "6",
@@ -136,6 +142,7 @@ def main():
         "stream_overlap_bench",
         "ingest_bench",
         "plan_pipeline_bench",
+        "relational_bench",
         "checkpoint_bench",
         "overload_bench",
         "blackbox_bench",

@@ -46,8 +46,10 @@ from .api import (
     reduce_blocks_stream,
     reduce_rows,
     row,
+    scan,
 )
-from .lazy import explain_analyze
+from .lazy import RelationalFrame, explain_analyze
+from .graph.plan import col
 from .globalframe import GlobalFrame
 from .graph import Graph, ShapeHints
 from .graph import builder as dsl
@@ -125,6 +127,9 @@ __all__ = [
     "reduce_blocks_stream",
     "reduce_rows",
     "row",
+    "scan",
+    "col",
+    "RelationalFrame",
     "ingest",
     "serving",
     "stream_dataset",

@@ -1819,6 +1819,55 @@ def group_by(frame: TensorFrame, *keys: str) -> GroupedFrame:
     return GroupedFrame(frame, keys)
 
 
+def _agg_spec_exprs(frame: TensorFrame, specs: Dict[str, Tuple[str, str]]):
+    """Lower ``out=(op, column)`` aggregation specs to the DSL reduce
+    fetches + feed_dict the `aggregate` verb wants — shared by the
+    eager `GroupedFrame.agg` and the relational groupby plan node (both
+    lower onto the same segment/vmap/chunk plans)."""
+    from .graph.plan import AGG_OPS
+
+    fetches = []
+    feed: Dict[str, str] = {}
+    for out, spec in sorted(specs.items()):
+        if (
+            not isinstance(spec, (tuple, list)) or len(spec) != 2
+            or not all(isinstance(s, str) for s in spec)
+        ):
+            raise TypeError(
+                f"agg spec {out}={spec!r}: want a ('op', 'column') pair"
+            )
+        op, colname = spec
+        if op not in AGG_OPS:
+            raise ValueError(f"agg op {op!r} is not one of {list(AGG_OPS)}")
+        ph = dsl.block(frame, colname, tf_name=f"{out}_input")
+        fetches.append(getattr(dsl, f"reduce_{op}")(ph, axes=[0]).named(out))
+        feed[f"{out}_input"] = colname
+    return fetches, feed
+
+
+def scan(source, format: str = "auto", columns=None, chunk_groups: int = 1):
+    """Lazily scan an on-disk dataset (parquet / arrow IPC) as a
+    `RelationalFrame` — the relational plan's ingest leaf. Composes
+    with `filter` / `select` / `map_blocks` / `group_by(...).agg(...)`;
+    the plan optimizer pushes predicates and the pruned column set INTO
+    the decode pipeline (skipping whole parquet row groups from footer
+    stats), so a selective plan decodes the rows that survive, not the
+    whole dataset. ``source`` is a path / path list / `ingest.Dataset`."""
+    from .graph import plan as _plan
+    from .ingest import Dataset
+    from .lazy import RelationalFrame
+
+    ds = (
+        source
+        if isinstance(source, Dataset)
+        else Dataset(source, format=format, chunk_groups=chunk_groups)
+    )
+    payload: Dict[str, object] = {"dataset": ds}
+    if columns is not None:
+        payload["columns"] = tuple(columns)
+    return RelationalFrame(_plan.PlanNode("scan", (), payload))
+
+
 # The three aggregation plans live in aggregate.py (segment ops /
 # exact per-size vmap / pow2-chunk monoid combine); re-exported below
 # so parallel/verbs.py and parallel/multihost.py keep resolving them
@@ -2031,9 +2080,18 @@ def explain(frame: TensorFrame) -> str:
     """`OperationsInterface.explain` (`DebugRowOps.scala:535-552`).
 
     For a `LazyFrame`, renders the fused plan with per-stage provenance
-    (deferred verbs, feeds, pending outputs) above the schema."""
-    from .lazy import LazyFrame
+    (deferred verbs, feeds, pending outputs) above the schema. For a
+    `RelationalFrame` (or its `LazyPlan`), renders the pre- AND
+    post-optimization DAG with per-node costed estimates and every
+    rewrite decision (accepted and rejected) — WITHOUT executing."""
+    from .lazy import LazyFrame, LazyPlan, RelationalFrame
 
+    if isinstance(frame, RelationalFrame):
+        return frame.explain_plan()
+    if isinstance(frame, LazyPlan):
+        if frame.relational is not None:
+            return RelationalFrame(frame.relational).explain_plan()
+        return repr(frame)
     if isinstance(frame, LazyFrame):
         return frame.explain_plan()
     return frame.info.explain()
@@ -2134,6 +2192,17 @@ def _install_fluent_methods() -> None:
     def _row(self, col, tf_name=None):
         return dsl.row(self, col, tf_name)
 
+    # relational verbs: compose lazily as plan-DAG nodes (graph.plan);
+    # force() runs them through the cost-based optimizer
+    def _filter(self, pred, selectivity=None):
+        return self.lazy().filter(pred, selectivity=selectivity)
+
+    def _sort_by(self, *keys, descending=False):
+        return self.lazy().sort_by(*keys, descending=descending)
+
+    def _join(self, other, on, how="inner"):
+        return self.lazy().join(other, on, how=how)
+
     TensorFrame.map_blocks = _map_blocks
     TensorFrame.map_rows = _map_rows
     TensorFrame.reduce_blocks = _reduce_blocks
@@ -2141,11 +2210,23 @@ def _install_fluent_methods() -> None:
     TensorFrame.group_by = _group_by
     TensorFrame.block = _block
     TensorFrame.row = _row
+    TensorFrame.filter = _filter
+    TensorFrame.sort_by = _sort_by
+    TensorFrame.join = _join
 
     def _agg(self, fetches, **kw):
         return aggregate(fetches, self, **kw)
 
+    def _agg_specs(self, **specs):
+        """Keyed aggregation from ``out=('op', column)`` specs (ops:
+        sum / mean / min / max) — the eager sibling of the relational
+        `LazyGroupedFrame.agg`; lowers onto the same segment/vmap
+        aggregation plans."""
+        fetches, feed = _agg_spec_exprs(self.frame, specs)
+        return aggregate(fetches, self, feed_dict=feed)
+
     GroupedFrame.aggregate = _agg
+    GroupedFrame.agg = _agg_specs
 
 
 _install_fluent_methods()

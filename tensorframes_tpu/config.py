@@ -377,6 +377,34 @@ class Config:
             minimum=1,
         )
     )
+    # Relational plan optimizer (`graph.optimizer`): rewrite the plan
+    # DAG built by filter/select/group_by/sort_by/join before
+    # execution — common-subplan dedup, filter-below-map reordering,
+    # predicate pushdown into the ingest scan, column pruning, and
+    # map fusion across relational boundaries. Every rewrite is priced
+    # against the cost ledger's residuals-corrected throughput and
+    # accepted only when the modeled plan cost strictly drops; off =
+    # execute the verbs exactly as written (the A/B baseline
+    # benchmarks/relational_bench.py measures against). Env override
+    # TFS_PLAN_OPTIMIZER ("0" disables) seeds the initial value.
+    plan_optimizer: bool = dataclasses.field(
+        default_factory=lambda: _env_bool(
+            "TFS_PLAN_OPTIMIZER", True, "plan_optimizer"
+        )
+    )
+    # Default filter selectivity the plan optimizer assumes when a
+    # `filter(...)` carries no explicit selectivity= hint: the modeled
+    # fraction of rows that survive the predicate. Feeds the cost
+    # estimates in tfs.explain() and the accept/reject pricing of
+    # pushdown rewrites; it never affects results, only plan choice.
+    # Env override TFS_PLAN_SELECTIVITY_DEFAULT seeds the initial
+    # value.
+    plan_selectivity_default: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "TFS_PLAN_SELECTIVITY_DEFAULT", 0.5,
+            "plan_selectivity_default", minimum=0.0,
+        )
+    )
     # Materialization cache byte budget (`runtime.materialize`): total
     # on-disk bytes the content-keyed result cache may hold; LRU
     # entries evict to stay under it. 0 (the default) disables the

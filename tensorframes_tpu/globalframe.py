@@ -177,6 +177,13 @@ def reset_state() -> None:
             frames=0, dispatches=0, collectives=0, pad_rows=0,
             fallbacks={}, last_shards=None, stream_folds=0,
         )
+        _filter_fns.clear()
+
+
+# jitted predicate-mask programs, keyed by (canonical predicate
+# fingerprint, feed column tuple) — these have no Graph so they cannot
+# ride the executor's `cached()`; cleared by `reset_state`
+_filter_fns: Dict = {}
 
 
 # ---------------------------------------------------------------------------
@@ -975,6 +982,53 @@ def fused_reduce_global(
     )
 
 
+def filter_global(pred, gf: GlobalFrame, executor=None):
+    """Relational filter on the SPMD path: ONE mask dispatch (the
+    predicate plus the valid-row guard compile into a single program
+    over the whole mesh), then a host compact of the survivors and a
+    re-globalize. Returns the filtered `GlobalFrame` — or ``None``
+    when the plan cannot stay on the SPMD path (executor cannot take
+    sharded arrays, predicate reads a missing / non-scalar column);
+    the caller then falls back, counted, to the local block path."""
+    from .runtime.executor import default_executor
+
+    ex = executor or default_executor()
+    if not _spmd_capable(ex):
+        return None
+    cols = sorted(pred.columns())
+    for c in cols:
+        if c not in gf.columns:
+            return None  # surface the clear missing-column error locally
+        if gf.info[c].block_shape.rank != 1:
+            return None  # predicate over tensor cells: not expressible
+    key = (pred.fingerprint(), tuple(cols))
+    with _state_lock:
+        fn = _filter_fns.get(key)
+    if fn is None:
+        import jax.numpy as jnp
+
+        def _mask_fn(valid, *arrs):
+            lookup = dict(zip(cols, arrs))
+            m = pred.mask(lambda n: lookup[n])
+            return (m & (jnp.arange(arrs[0].shape[0]) < valid),)
+
+        fn = jax.jit(_mask_fn)
+        with _state_lock:
+            fn = _filter_fns.setdefault(key, fn)
+    feeds = [gf.column(c).values for c in cols]
+    outs = _dispatch_one(
+        "plan.filter.mask", "filter", fn, gf.nrows, gf, feeds,
+        f"plan-filter:{pred.fingerprint()}",
+    )
+    take = np.flatnonzero(np.asarray(outs[0]))
+    base = gf.to_frame()
+    data = {n: np.asarray(base.host_values(n))[take] for n in gf.columns}
+    local = TensorFrame.from_dict(data)
+    if take.size == 0:
+        return local  # nothing to shard; downstream stages stay local
+    return GlobalFrame.from_frame(local, mesh=gf.mesh)
+
+
 # ---------------------------------------------------------------------------
 # fluent methods (mirror TensorFrame's: gf.map_blocks(...) etc.)
 # ---------------------------------------------------------------------------
@@ -996,11 +1050,26 @@ def _install_fluent_methods() -> None:
     def _group_by(self, *keys):
         return _api.GroupedFrame(self, keys)
 
+    # relational verbs: defer as plan-DAG nodes over this GlobalFrame
+    # (filter lowers to the one-dispatch mask+compact above; groupby to
+    # the segment recipe; sort/join fall back counted)
+    def _filter(self, pred, selectivity=None):
+        return self.lazy().filter(pred, selectivity=selectivity)
+
+    def _sort_by(self, *keys, descending=False):
+        return self.lazy().sort_by(*keys, descending=descending)
+
+    def _join(self, other, on, how="inner"):
+        return self.lazy().join(other, on, how=how)
+
     GlobalFrame.map_blocks = _map_blocks
     GlobalFrame.map_rows = _map_rows
     GlobalFrame.reduce_blocks = _reduce_blocks
     GlobalFrame.reduce_rows = _reduce_rows
     GlobalFrame.group_by = _group_by
+    GlobalFrame.filter = _filter
+    GlobalFrame.sort_by = _sort_by
+    GlobalFrame.join = _join
 
 
 _install_fluent_methods()

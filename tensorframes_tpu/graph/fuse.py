@@ -25,11 +25,28 @@ performs the validated rewiring.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Tuple
 
 from .ir import Graph, GraphNode, parse_edge
 
-__all__ = ["splice"]
+__all__ = ["splice", "chain_fingerprint"]
+
+
+def chain_fingerprint(graph: Graph, feed_map: Dict[str, str],
+                      outputs) -> str:
+    """Canonical digest of one fused verb chain: the spliced graph's
+    content fingerprint plus the placeholder->column bindings and the
+    (sorted) output column set. This is the identity a fused chain
+    contributes to a relational plan fingerprint (`graph.plan`) — two
+    chains that fused to the same program over the same bindings key
+    identically no matter how many verb calls produced them."""
+    h = hashlib.sha256(graph.fingerprint().encode())
+    for ph, colname in sorted(feed_map.items()):
+        h.update(f"|{ph}={colname}".encode())
+    for out in sorted(outputs):
+        h.update(f"|>{out}".encode())
+    return h.hexdigest()[:16]
 
 
 def _rewired_edge(edge: str, target: str) -> str:

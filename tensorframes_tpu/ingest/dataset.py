@@ -30,6 +30,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional, Sequence, Tuple, Union
 
+import numpy as np
+
 from .pipeline import PipeStage, pipelined
 
 __all__ = [
@@ -121,6 +123,30 @@ class ChunkTask:
     groups: Tuple[int, ...]
     shard_index: int
     rows: int = field(default=-1)  # from metadata; -1 = unknown
+
+
+def _group_stats(md, group: int, cols: Sequence[str]):
+    """(min, max) per predicate column from one parquet row group's
+    footer statistics, or None when any needed column lacks stats (the
+    group must then be decoded — pruning is strictly conservative)."""
+    try:
+        rg = md.row_group(group)
+        by_name = {}
+        for ci in range(rg.num_columns):
+            c = rg.column(ci)
+            by_name[c.path_in_schema] = c
+        stats = {}
+        for name in cols:
+            c = by_name.get(name)
+            if c is None or c.statistics is None:
+                return None
+            st = c.statistics
+            if not st.has_min_max:
+                return None
+            stats[name] = (st.min, st.max)
+        return stats
+    except Exception:
+        return None
 
 
 def _chunk_context(task) -> dict:
@@ -252,30 +278,89 @@ class Dataset:
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
     # -- decode stage --------------------------------------------------
-    def decode(self, task: ChunkTask):
+    def decode(self, task: ChunkTask, columns=None, predicate=None):
         """One chunk -> one `TensorFrame`; opens and CLOSES the shard
         (try/finally) so a pool of decode workers never accumulates
-        handles, and an abandoned stream leaks nothing."""
-        from ..frame import TensorFrame
+        handles, and an abandoned stream leaks nothing.
 
+        ``columns`` / ``predicate`` are the plan optimizer's pushdown
+        surface (`graph.optimizer`): the column set narrows the
+        parquet read to the selected + predicate columns, and the
+        predicate prunes whole row groups from footer (min, max) stats
+        BEFORE decode — skipped rows count into
+        ``plan_pushdown_rows_skipped`` — then masks the survivors at
+        the arrow boundary, so fewer rows are decoded, not more rows
+        masked. Unknown requested columns are dropped here (the plan's
+        select/map stages raise the precise schema error); every
+        decoded row counts into ``ingest_rows_decoded``."""
+        from ..frame import TensorFrame
+        from ..utils import telemetry as _tele
+
+        pred_cols = sorted(predicate.columns()) if predicate is not None else []
         if task.format == "parquet":
             import pyarrow.parquet as pq
 
             pf = pq.ParquetFile(task.shard)
             try:
-                table = pf.read_row_groups(list(task.groups))
+                md = pf.metadata
+                groups = list(task.groups)
+                if predicate is not None:
+                    kept, skipped_rows = [], 0
+                    for g in groups:
+                        stats = _group_stats(md, g, pred_cols)
+                        if stats is not None and not predicate.may_match(stats):
+                            skipped_rows += md.row_group(g).num_rows
+                        else:
+                            kept.append(g)
+                    if skipped_rows:
+                        from ..graph import plan as _plan
+
+                        _plan.note_pushdown_rows(skipped_rows)
+                    groups = kept
+                schema_names = pf.schema_arrow.names
+                read_cols = None
+                if columns is not None:
+                    read_cols = [
+                        c
+                        for c in dict.fromkeys(list(columns) + pred_cols)
+                        if c in schema_names
+                    ]
+                if not groups:
+                    table = pf.schema_arrow.empty_table()
+                    if read_cols is not None:
+                        table = table.select(read_cols)
+                else:
+                    table = pf.read_row_groups(groups, columns=read_cols)
             finally:
                 pf.close()
-            return TensorFrame.from_arrow(table)
-        import pyarrow as pa
+        else:
+            import pyarrow as pa
 
-        source = pa.OSFile(task.shard, "rb")
-        try:
-            reader = pa.ipc.open_file(source)
-            batches = [reader.get_batch(i) for i in task.groups]
-            table = pa.Table.from_batches(batches, schema=reader.schema)
-        finally:
-            source.close()
+            source = pa.OSFile(task.shard, "rb")
+            try:
+                reader = pa.ipc.open_file(source)
+                batches = [reader.get_batch(i) for i in task.groups]
+                table = pa.Table.from_batches(batches, schema=reader.schema)
+            finally:
+                source.close()
+            if columns is not None:
+                keep = [
+                    c
+                    for c in dict.fromkeys(list(columns) + pred_cols)
+                    if c in table.column_names
+                ]
+                table = table.select(keep)
+        if predicate is not None and table.num_rows:
+            import pyarrow as pa
+
+            mask = predicate.mask(
+                lambda n: table.column(n).to_numpy(zero_copy_only=False)
+            )
+            table = table.filter(pa.array(np.asarray(mask, dtype=bool)))
+        if columns is not None:
+            keep = [c for c in columns if c in table.column_names]
+            table = table.select(keep)
+        _tele.counter_inc("ingest_rows_decoded", float(table.num_rows))
         return TensorFrame.from_arrow(table)
 
 

@@ -109,8 +109,29 @@ class LazyPlan:
     sources: Dict[str, str] = field(default_factory=dict)  # col -> fused edge
     feeds: Dict[str, str] = field(default_factory=dict)  # placeholder -> base col
     info: Optional[FrameInfo] = None
+    # relational plans carry the OPTIMIZED `graph.plan.PlanNode` DAG
+    # root instead of a linear fused chain
+    relational: Optional[object] = None
+
+    def fingerprint(self) -> str:
+        """Canonical identity of the plan — the materialization-cache
+        key side that is about the COMPUTATION. Relational plans
+        fingerprint their optimized DAG (commutative predicate operands
+        sort, leaves contribute ordinals), so semantically equal plans
+        — pre/post rewrite, reordered `&`/`|` inputs — share one key;
+        linear fused chains digest the spliced graph + bindings +
+        output set."""
+        if self.relational is not None:
+            from .graph import plan as _plan
+
+            return _plan.plan_fingerprint(self.relational)
+        from .graph.fuse import chain_fingerprint
+
+        return chain_fingerprint(self.graph, self.feeds, sorted(self.sources))
 
     def __repr__(self) -> str:
+        if self.relational is not None:
+            return f"LazyPlan(relational, fingerprint {self.fingerprint()})"
         return (
             f"LazyPlan({len(self.stages)} stages, {len(self.graph)} nodes, "
             f"outputs {sorted(self.sources)}, feeds {self.feeds})"
@@ -1025,6 +1046,334 @@ class LazyFrame:
         lines.append(self.info.explain())
         return "\n".join(lines)
 
+    # -- relational verbs (seal the fused chain into a plan DAG) --------
+    def _to_plan_node(self):
+        """The pending fused chain as a `graph.plan` DAG fragment: the
+        base frame as a ``source`` leaf, plus (when stages are pending)
+        ONE opaque ``map`` node carrying the whole spliced chain —
+        execution replays it through this class, so fusion/bucketing/
+        SPMD routing are identical to a plain `force()`."""
+        from .graph import plan as _plan
+
+        node = _plan.PlanNode("source", (), {"frame": self._base})
+        if self._sources:
+            node = _plan.PlanNode("map", (node,), {
+                "kind": "fused",
+                "graph": self._graph,
+                "sources": dict(self._sources),
+                "feed_map": dict(self._feed_map),
+                "stages": list(self._stages),
+            })
+        return node
+
+    def _relational(self) -> "RelationalFrame":
+        return RelationalFrame(self._to_plan_node(), executor=self._executor)
+
+    def filter(self, pred, selectivity: Optional[float] = None):
+        """Relational filter: defers as a plan-DAG node (`graph.plan`);
+        the optimizer may reorder it below maps or push it into the
+        ingest scan. ``selectivity`` is an optional 0..1 hint for the
+        cost model (default `config.plan_selectivity_default`)."""
+        return self._relational().filter(pred, selectivity=selectivity)
+
+    def select(self, names):
+        """Relational projection (column pruning seed)."""
+        return self._relational().select(names)
+
+    def sort_by(self, *keys: str, descending: bool = False):
+        return self._relational().sort_by(*keys, descending=descending)
+
+    def join(self, other, on, how: str = "inner"):
+        return self._relational().join(other, on, how=how)
+
+
+# ---------------------------------------------------------------------------
+# RelationalFrame: a deferred relational plan DAG
+# ---------------------------------------------------------------------------
+
+
+def _as_plan_node(obj):
+    """Coerce a frame-like object to a plan-DAG node (join inputs)."""
+    from .graph import plan as _plan
+
+    if isinstance(obj, RelationalFrame):
+        return obj._node
+    if isinstance(obj, LazyFrame):
+        return obj._to_plan_node()
+    return _plan.PlanNode("source", (), {"frame": obj})
+
+
+class RelationalFrame:
+    """A frame defined by a pending relational plan DAG.
+
+    Built by the relational verbs on `TensorFrame` / `LazyFrame` /
+    `GlobalFrame` or by `tfs.scan(...)`; verbs compose lazily into
+    `graph.plan.PlanNode`s, `force()` optimizes the DAG through the
+    cost-based rewriter (`graph.optimizer`, `config.plan_optimizer`),
+    consults the materialization cache under the CANONICAL plan
+    fingerprint, then lowers node-by-node onto the existing executors
+    (`graph.plan.execute`). All state is immutable — every verb
+    returns a new `RelationalFrame`, so plans branch like frames do."""
+
+    def __init__(self, node, executor=None):
+        self._node = node
+        self._executor = executor
+        self._forced = None
+        self._opt: Optional[Tuple] = None  # (optimized node, decisions)
+
+    def _chain(self, node) -> "RelationalFrame":
+        return RelationalFrame(node, executor=self._executor)
+
+    # -- verbs ----------------------------------------------------------
+    def filter(self, pred, selectivity: Optional[float] = None):
+        from .graph import plan as _plan
+
+        if not isinstance(pred, _plan.Pred):
+            raise TypeError(
+                "filter wants a predicate built from tfs.col(...) "
+                f"comparisons, got {type(pred).__name__}"
+            )
+        payload: Dict[str, object] = {"pred": pred}
+        if selectivity is not None:
+            s = float(selectivity)
+            if not 0.0 <= s <= 1.0:
+                raise ValueError(
+                    f"filter selectivity hint must be in [0, 1], got {s}"
+                )
+            payload["selectivity"] = s
+        return self._chain(
+            _plan.PlanNode("filter", (self._node,), payload)
+        )
+
+    def select(self, names):
+        from .graph import plan as _plan
+
+        names = [names] if isinstance(names, str) else list(names)
+        return self._chain(
+            _plan.PlanNode("select", (self._node,), {"columns": tuple(names)})
+        )
+
+    def sort_by(self, *keys: str, descending: bool = False):
+        from .graph import plan as _plan
+
+        if not keys:
+            raise ValueError("sort_by needs at least one key column")
+        return self._chain(_plan.PlanNode("sort", (self._node,), {
+            "keys": tuple(keys), "descending": bool(descending),
+        }))
+
+    def join(self, other, on, how: str = "inner"):
+        from .graph import plan as _plan
+
+        if how != "inner":
+            raise ValueError(
+                f"join how={how!r}: only the hash equi-join ('inner') "
+                "is implemented"
+            )
+        on = (on,) if isinstance(on, str) else tuple(on)
+        return self._chain(_plan.PlanNode(
+            "join", (self._node, _as_plan_node(other)),
+            {"on": on, "how": how},
+        ))
+
+    def group_by(self, *keys: str) -> "LazyGroupedFrame":
+        if not keys:
+            raise ValueError("group_by needs at least one key column")
+        return LazyGroupedFrame(self, keys)
+
+    def map_blocks(self, fetches, feed_dict=None, fetch_names=None):
+        """Deferred row-local map stage. Adjacent map stages fuse into
+        ONE XLA program at execution (via the ordinary `LazyFrame`
+        splice), including across relational boundaries the optimizer
+        clears."""
+        from . import api as _api
+        from .graph import plan as _plan
+
+        if callable(fetches) and not isinstance(fetches, _api.dsl.Tensor):
+            # the tracer front-end needs a concrete frame to name/shape
+            # its placeholders — a plan node has none until execution
+            raise TypeError(
+                "relational map_blocks wants graph fetches (dsl "
+                "expressions / Graph / GraphDef); the traced-function "
+                "front-end needs a concrete frame — force() first or "
+                "build the map with tfs.dsl placeholders"
+            )
+        graph, fetch_list = _api._as_graph(fetches, fetch_names)
+        feeds: set = set()
+        for ph in graph.placeholders():
+            name = ph.name
+            if feed_dict and name in feed_dict:
+                feeds.add(feed_dict[name])
+                continue
+            # without an executed frame the default-matching convention
+            # (exact name, else strip _input/_k suffixes) cannot be
+            # resolved yet — demand both candidates so column pruning
+            # never drops the one that matches at execution
+            feeds.add(name)
+            for suf in _api._REDUCE_SUFFIXES:
+                if name.endswith(suf):
+                    feeds.add(name[: -len(suf)])
+        stage = {
+            "graph": graph,
+            "fetch_list": list(fetch_list),
+            "feed_dict": dict(feed_dict or {}),
+            "feeds": frozenset(feeds),
+        }
+        return self._chain(_plan.PlanNode(
+            "map", (self._node,), {"kind": "exprs", "stages": [stage]},
+        ))
+
+    # -- terminals -------------------------------------------------------
+    def optimize(self) -> Tuple:
+        """(optimized DAG root, decision records) — memoized; identity
+        rewrite when `config.plan_optimizer` is off."""
+        if self._opt is None:
+            from . import config as _config
+
+            if _config.get().plan_optimizer:
+                from .graph import optimizer as _optm
+
+                self._opt = _optm.optimize(self._node, self._executor)
+            else:
+                self._opt = (self._node, [])
+        return self._opt
+
+    def force(self, executor=None):
+        """Optimize, consult the materialization cache under the
+        canonical plan fingerprint, then execute the DAG."""
+        if executor is None and self._forced is not None:
+            return self._forced
+        from .graph import plan as _plan
+        from .runtime import materialize as _mat
+
+        _plan._note_force()
+        node, _ = self.optimize()
+        ex = executor or self._executor
+        data_fp = plan_fp = None
+        if ex is None and _mat.enabled():
+            data_fp = _plan.data_fingerprint(node)
+            if data_fp is not None:
+                plan_fp = _mat.relational_fingerprint(
+                    _plan.plan_fingerprint(node)
+                )
+                hit = _mat.lookup(data_fp, plan_fp)
+                if hit is not None:
+                    _plan.note_cache_hit()
+                    self._forced = hit
+                    return hit
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = _plan.execute(node, executor=ex)
+        compute_s = _time.perf_counter() - t0
+        if plan_fp is not None and isinstance(out, TensorFrame):
+            try:
+                _mat.store(data_fp, plan_fp, out, compute_s=compute_s)
+            except Exception:
+                pass  # cache is an optimization, never a failure mode
+        if executor is None:
+            self._forced = out
+        return out
+
+    def collect(self):
+        return self.force().collect()
+
+    def plan(self) -> LazyPlan:
+        """The OPTIMIZED plan as a `LazyPlan` (fingerprintable)."""
+        node, _ = self.optimize()
+        return LazyPlan([], Graph(), relational=node)
+
+    def explain_plan(self) -> str:
+        """Pre- and post-optimization DAG with per-node costed
+        estimates and every rewrite decision — WITHOUT executing (the
+        non-executing sibling of `explain_analyze`)."""
+        from .graph import plan as _plan
+        from .graph.optimizer import Estimator
+
+        node, decisions = self.optimize()
+        pre_est = Estimator(self._executor)
+        post_est = Estimator(self._executor)
+
+        def annot(est):
+            def fn(n):
+                rows, cols = est.shape(n)
+                return (
+                    f"~{rows:,.0f} rows x {cols:.0f} cols, "
+                    f"est {est.node_cost(n) * 1e3:.3f} ms"
+                )
+            return fn
+
+        lines = ["RelationalFrame plan (pre-optimization):"]
+        lines.append(_plan.render(self._node, annot(pre_est)))
+        lines.append(
+            f"  modeled total: {pre_est.plan_cost(self._node) * 1e3:.3f} ms"
+        )
+        lines.append("optimized plan:")
+        lines.append(_plan.render(node, annot(post_est)))
+        lines.append(
+            f"  modeled total: {post_est.plan_cost(node) * 1e3:.3f} ms"
+        )
+        lines.append("rewrite decisions:")
+        if not decisions:
+            lines.append("  (none)")
+        for d in decisions:
+            verdict = "accepted" if d["accepted"] else "REJECTED (regression)"
+            lines.append(
+                f"  {d['rule']}: {verdict} — {d['detail']} "
+                f"[{d['cost_before_s'] * 1e3:.3f} ms -> "
+                f"{d['cost_after_s'] * 1e3:.3f} ms]"
+            )
+        lines.append(f"plan fingerprint: {_plan.plan_fingerprint(node)}")
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        from .graph import plan as _plan
+
+        return f"RelationalFrame<\n{_plan.render(self._node)}\n>"
+
+
+class LazyGroupedFrame:
+    """`RelationalFrame.group_by(...)` handle: `.agg(out=("op", col))`
+    appends a lazy groupby-agg node (ops: sum / mean / min / max) —
+    the lazy sibling of the eager `GroupedFrame`."""
+
+    def __init__(self, rel: RelationalFrame, keys: Tuple[str, ...]):
+        for k in keys:
+            if not isinstance(k, str):
+                raise TypeError(
+                    f"group_by keys must be column names, got {type(k).__name__}"
+                )
+        self._rel = rel
+        self._keys = tuple(keys)
+
+    def agg(self, **specs) -> RelationalFrame:
+        from .graph import plan as _plan
+
+        if not specs:
+            raise ValueError(
+                "agg needs at least one out=(op, column) spec, e.g. "
+                "total=('sum', 'x')"
+            )
+        parsed: Dict[str, Tuple[str, str]] = {}
+        for out, spec in specs.items():
+            if (
+                not isinstance(spec, (tuple, list)) or len(spec) != 2
+                or not all(isinstance(s, str) for s in spec)
+            ):
+                raise TypeError(
+                    f"agg spec {out}={spec!r}: want a ('op', 'column') pair"
+                )
+            op, colname = spec
+            if op not in _plan.AGG_OPS:
+                raise ValueError(
+                    f"agg op {op!r} is not one of {list(_plan.AGG_OPS)}"
+                )
+            parsed[out] = (op, colname)
+        node = _plan.PlanNode("groupby", (self._rel._node,), {
+            "keys": self._keys, "specs": parsed,
+        })
+        return self._rel._chain(node)
+
 
 # ---------------------------------------------------------------------------
 # explain_analyze: execute a plan and join observed spans with the
@@ -1274,6 +1623,12 @@ def explain_analyze(plan, format: str = "text"):
             plan._stages, plan._executor, plan._mesh, plan._devices,
         )
         action = fresh.force
+    elif isinstance(plan, RelationalFrame):
+        # fresh copy: bypass the memo so there is a real execution to
+        # measure; the optimizer pass itself records a `plan.optimize`
+        # stage span inside the window, so the coverage contract holds
+        fresh_rel = RelationalFrame(plan._node, executor=plan._executor)
+        action = fresh_rel.force
     elif callable(plan):
         action = plan
     else:
@@ -1289,6 +1644,8 @@ def explain_analyze(plan, format: str = "text"):
     result = action()
     if isinstance(result, LazyFrame):
         plan_obj = result.plan()
+        result = result.force()
+    elif isinstance(result, RelationalFrame):
         result = result.force()
     # drain the async tail INSIDE the window (dispatch spans measure
     # issue time; the device finishing its queue is part of the plan's

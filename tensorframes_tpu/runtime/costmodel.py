@@ -646,6 +646,7 @@ def residuals(span_list=None) -> Dict:
         g["seconds"] += s.seconds
         g["dispatches"] += 1
         g["rows"] += float(rows or 0)
+        g.setdefault("op_class", _op_class(s.name))
     # first-call XLA shape specializations happen INSIDE the dispatch
     # window (jit compiles on call), so a program's compile spans are
     # subtracted from its achieved dispatch seconds — the residual
@@ -694,6 +695,7 @@ def residuals(span_list=None) -> Dict:
             {
                 "program": fp,
                 "rows": lead,
+                "op_class": g.get("op_class", "map"),
                 "dispatches": g["dispatches"],
                 "achieved_s": g["seconds"],
                 "compile_s_excluded": g.get("compile_s_excluded", 0.0),
@@ -702,17 +704,38 @@ def residuals(span_list=None) -> Dict:
             }
         )
     fit_b_num = fit_b_den = fit_f_num = fit_f_den = 0.0
+    # per-op-class rollup (map / reduce / relational): the planner's
+    # calibrated throughput for program fingerprints it never dispatched
+    cls_fit: Dict[str, Dict[str, float]] = {}
     for r in joined:
         if r["achieved_s"] <= 0:
             continue
+        c = cls_fit.setdefault(
+            r["op_class"],
+            {"b_num": 0.0, "b_den": 0.0, "f_num": 0.0, "f_den": 0.0,
+             "groups": 0},
+        )
+        c["groups"] += 1
         if r["modeled_bytes"] is not None:
             fit_b_num += r["modeled_bytes"] * r["dispatches"]
             fit_b_den += r["achieved_s"]
+            c["b_num"] += r["modeled_bytes"] * r["dispatches"]
+            c["b_den"] += r["achieved_s"]
         if r["modeled_flops"] is not None:
             fit_f_num += r["modeled_flops"] * r["dispatches"]
             fit_f_den += r["achieved_s"]
+            c["f_num"] += r["modeled_flops"] * r["dispatches"]
+            c["f_den"] += r["achieved_s"]
     eff_bytes = fit_b_num / fit_b_den if fit_b_den > 0 else None
     eff_flops = fit_f_num / fit_f_den if fit_f_den > 0 else None
+    by_class = {
+        cls: {
+            "bytes_per_s": c["b_num"] / c["b_den"] if c["b_den"] > 0 else None,
+            "flops_per_s": c["f_num"] / c["f_den"] if c["f_den"] > 0 else None,
+            "groups": c["groups"],
+        }
+        for cls, c in cls_fit.items()
+    }
     peaks = device_peaks()
     warn = float(
         getattr(_config.get(), "cost_residual_warn_ratio", 0.0) or 0.0
@@ -778,11 +801,44 @@ def residuals(span_list=None) -> Dict:
             "flops_per_s": eff_flops,
             "groups": len(joined),
         },
+        "by_class": by_class,
         "groups": sorted(
             joined, key=lambda r: (r["program"], r["rows"] or 0)
         ),
         "programs": per_prog,
     }
+
+
+def _op_class(span_name: str) -> str:
+    """map / reduce / relational bucket for a dispatch span — coarse
+    on purpose: the planner wants a calibrated figure for op SHAPES it
+    has never dispatched, and three bandwidth classes is what the
+    ledger can actually distinguish."""
+    n = span_name or ""
+    if n.startswith("plan."):
+        return "relational"
+    if "reduce" in n or "aggregate" in n:
+        return "reduce"
+    return "map"
+
+
+def planner_throughput(op_class: str) -> Optional[float]:
+    """Residuals-corrected effective bytes/second for one op class
+    (``map`` / ``reduce`` / ``relational``): the per-class fit when
+    that class has dispatched, else the process-wide fit, else None
+    (the optimizer then uses its cold-start default). This is the
+    costing rule's measurement side — rewrites are priced against what
+    this process actually achieved, not a heuristic table."""
+    try:
+        res = residuals()
+    except Exception:
+        return None
+    ent = (res.get("by_class") or {}).get(op_class)
+    if ent and ent.get("bytes_per_s"):
+        return float(ent["bytes_per_s"])
+    fit = res.get("fit") or {}
+    v = fit.get("bytes_per_s")
+    return float(v) if v else None
 
 
 def _log2(x: float) -> float:

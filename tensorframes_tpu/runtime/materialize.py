@@ -196,6 +196,15 @@ def plan_fingerprint(graph_fp: str, feed_map=None, outputs=None) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def relational_fingerprint(dag_fp: str) -> str:
+    """The program half of the cache key for a RELATIONAL plan: the
+    canonical DAG fingerprint (`graph.plan.plan_fingerprint` — already
+    commutativity-normalized and rewrite-invariant after optimization),
+    namespaced so a relational plan can never collide with a linear
+    fused chain that happened to digest identically."""
+    return hashlib.sha256(f"relational:{dag_fp}".encode()).hexdigest()[:16]
+
+
 def _key(data_fp: str, plan_fp: str, cfg: str) -> str:
     return f"{data_fp}-{plan_fp}-{cfg}"
 

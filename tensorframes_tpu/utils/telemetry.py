@@ -1015,6 +1015,14 @@ _PROM_HELP: Dict[str, str] = {
     ),
     "incident_bytes": "Bytes held by on-disk incident bundles",
     "incident_capture_seconds": "Incident bundle capture latency",
+    "plan_rewrites": "Cost-accepted plan-optimizer rewrites by rule",
+    "plan_fallbacks": (
+        "Relational plan nodes that left the global SPMD path, by reason"
+    ),
+    "plan_pushdown_rows_skipped": (
+        "Rows never decoded thanks to predicate pushdown into the scan"
+    ),
+    "ingest_rows_decoded": "Rows decoded at the arrow ingest boundary",
 }
 
 
@@ -1257,6 +1265,14 @@ def diagnostics_data(executor=None) -> Dict:
         data["materialize"] = _materialize.state()
     except Exception as e:
         data["materialize"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # plan optimizer: relational rewrite/fallback/pushdown accounting ----
+    try:
+        from ..graph import plan as _planmod
+
+        data["plan_optimizer"] = _planmod.state()
+    except Exception as e:
+        data["plan_optimizer"] = {"error": f"{type(e).__name__}: {e}"}
 
     # flight recorder: incident capture/suppression accounting -----------
     try:
@@ -1665,6 +1681,28 @@ def _render_diagnostics(data: Dict) -> str:
                 f"{_fmt_bytes(lh['bytes'])} in "
                 f"{lh['load_seconds'] * 1e3:.1f}ms"
             )
+
+    # plan optimizer -----------------------------------------------------
+    po = data.get("plan_optimizer", {})
+    if po and "error" not in po and (
+        po.get("forces") or po.get("optimize_runs")
+        or po.get("executed_nodes")
+    ):
+        lines.append("")
+        lines.append(
+            f"plan optimizer: {po.get('forces', 0)} plan force(s), "
+            f"{po.get('optimize_runs', 0)} optimize run(s), "
+            f"{po.get('executed_nodes', 0)} node(s) executed, "
+            f"{po.get('cache_hits', 0)} materialization hit(s); "
+            f"{po.get('pushdown_rows_skipped', 0)} row(s) never decoded "
+            "via predicate pushdown"
+        )
+        for rule, n in sorted((po.get("rewrites") or {}).items()):
+            lines.append(f"  rewrite {rule}: {n} accepted")
+        for rule, n in sorted((po.get("rejected") or {}).items()):
+            lines.append(f"  rewrite {rule}: {n} cost-rejected")
+        for reason, n in sorted((po.get("fallbacks") or {}).items()):
+            lines.append(f"  fallback {reason}: {n} node(s)")
 
     # flight recorder ----------------------------------------------------
     bb = data.get("blackbox", {})

@@ -39,7 +39,7 @@ def _reset_telemetry():
     bleed into the next test's scheduling."""
     yield
     from tensorframes_tpu import config, globalframe, serving
-    from tensorframes_tpu.graph import vectorize
+    from tensorframes_tpu.graph import plan, vectorize
     from tensorframes_tpu.runtime import (
         autotune,
         blackbox,
@@ -65,3 +65,4 @@ def _reset_telemetry():
     materialize.reset_state()  # cached results never answer another test
     vectorize.reset_state()  # lowering/fallback ledger never leaks
     blackbox.reset_state()  # one test's incidents never explain another's
+    plan.reset_state()  # rewrite/fallback/pushdown ledger never leaks

@@ -1,0 +1,517 @@
+"""Relational verbs + the cost-based plan-DAG optimizer.
+
+The relational contract (ISSUE: plan optimizer): `filter` / `select` /
+`group_by(...).agg(...)` / `sort_by` / `join` compose lazily into a plan
+DAG; `graph.optimizer` rewrites it — predicate pushdown into the ingest
+scan, column pruning, filter-below-map reordering, common-subplan dedup,
+map fusion across relational boundaries — with every rewrite priced
+against the cost ledger and accepted only when the modeled plan cost
+strictly drops. Eligible plans on a `GlobalFrame` lower to ONE SPMD
+dispatch per stage; inexpressible constructs fall back loudly with
+counted ``plan_fallbacks{reason=}``. Semantically equal plans share one
+canonical fingerprint and therefore one materialization-cache key.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tensorframes_tpu as tfs
+from tensorframes_tpu import col, dsl
+from tensorframes_tpu import io as tio
+from tensorframes_tpu.graph import plan as planmod
+from tensorframes_tpu.lazy import RelationalFrame
+from tensorframes_tpu.runtime import materialize
+from tensorframes_tpu.schema import ScalarType, Shape
+from tensorframes_tpu.utils import telemetry
+
+
+def _frame(rows=600, blocks=4):
+    return tfs.TensorFrame.from_dict(
+        {
+            "x": np.arange(rows, dtype=np.float64),
+            "y": np.arange(rows, dtype=np.float64) % 3,
+            "w": np.ones(rows, dtype=np.float64),
+        },
+        num_blocks=blocks,
+    )
+
+
+def _write_shard(tmpdir, rows=10_000, blocks=10):
+    """One parquet file, `blocks` row groups, x ascending — so
+    row-group min/max stats genuinely prune a selective x-predicate."""
+    path = os.path.join(tmpdir, "part0.parquet")
+    tio.write_parquet(_frame(rows, blocks), path)
+    return path
+
+
+def _double_x():
+    ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="x")
+    return (ph * 2.0).named("z")
+
+
+def _inc_z():
+    ph = dsl.placeholder(ScalarType.float64, Shape((None,)), name="z")
+    return (ph + 1.0).named("w2")
+
+
+def _dispatches():
+    return [s for s in telemetry.spans() if s.kind == "dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# verb correctness vs pandas
+# ---------------------------------------------------------------------------
+
+
+class TestVerbCorrectness:
+    def test_filter_matches_pandas(self):
+        df = _frame()
+        out = df.lazy().filter((col("x") > 100.0) & ~(col("y") == 1.0))
+        got = out.force().to_pandas().reset_index(drop=True)
+        ref = df.to_pandas()
+        exp = ref[(ref.x > 100.0) & ~(ref.y == 1.0)].reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns.tolist()], exp)
+
+    def test_filter_rejects_python_bool_combination(self):
+        with pytest.raises(TypeError, match="combine predicates"):
+            bool(col("x") > 1.0)
+
+    def test_select_narrows_columns(self):
+        out = _frame().lazy().select(["y"]).force()
+        assert out.columns == ["y"]
+
+    def test_sort_by_matches_pandas(self):
+        df = _frame(rows=97, blocks=3)
+        got = df.lazy().sort_by("y", "x", descending=True).force()
+        ref = df.to_pandas().sort_values(
+            ["y", "x"], ascending=False
+        ).reset_index(drop=True)
+        pd.testing.assert_frame_equal(
+            got.to_pandas().reset_index(drop=True)[ref.columns.tolist()], ref
+        )
+
+    def test_groupby_agg_matches_pandas(self):
+        df = _frame()
+        got = (
+            df.lazy()
+            .select(["x", "y", "w"])  # relational entry: agg stays lazy
+            .group_by("y")
+            .agg(x_sum=("sum", "x"), x_max=("max", "x"), w_mean=("mean", "w"))
+            .force()
+            .to_pandas()
+            .sort_values("y")
+            .reset_index(drop=True)
+        )
+        ref = df.to_pandas().groupby("y", as_index=False).agg(
+            x_sum=("x", "sum"), x_max=("x", "max"), w_mean=("w", "mean")
+        )
+        pd.testing.assert_frame_equal(
+            got[["y", "x_sum", "x_max", "w_mean"]], ref, check_dtype=False
+        )
+
+    def test_agg_rejects_unknown_op(self):
+        with pytest.raises(ValueError, match="agg"):
+            _frame().lazy().group_by("y").agg(bad=("median", "x"))
+
+    def test_join_inner_equi_key(self):
+        df = _frame(rows=60, blocks=2)
+        right = tfs.TensorFrame.from_dict(
+            {
+                "y": np.arange(3, dtype=np.float64),
+                "label": np.array([10.0, 20.0, 30.0]),
+            }
+        )
+        got = df.lazy().join(right.lazy(), on="y").force().to_pandas()
+        ref = df.to_pandas().merge(right.to_pandas(), on="y", how="inner")
+        assert len(got) == len(ref)
+        assert set(got.columns) == set(ref.columns)
+        got = got.sort_values(["x"]).reset_index(drop=True)
+        ref = ref.sort_values(["x"]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[ref.columns.tolist()], ref)
+
+    def test_join_rejects_non_inner(self):
+        with pytest.raises(ValueError, match="inner"):
+            _frame().lazy().join(_frame().lazy(), on="y", how="left")
+
+    def test_chain_filter_map_groupby(self):
+        df = _frame()
+        got = (
+            df.lazy()
+            .filter(col("x") > 99.0)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+            .force()
+            .to_pandas()
+            .sort_values("y")
+            .reset_index(drop=True)
+        )
+        ref = df.to_pandas()
+        ref = ref[ref.x > 99.0].assign(z=lambda d: d.x * 2.0)
+        ref = ref.groupby("y", as_index=False).agg(z_sum=("z", "sum"))
+        pd.testing.assert_frame_equal(got[["y", "z_sum"]], ref,
+                                      check_dtype=False)
+
+    def test_traced_function_map_raises_helpfully(self):
+        rel = _frame().lazy().filter(col("x") > 0.0)
+        with pytest.raises(TypeError, match="dsl"):
+            rel.map_blocks(lambda x: {"z": x * 2.0})
+
+
+# ---------------------------------------------------------------------------
+# optimizer rewrites — priced against the ledger
+# ---------------------------------------------------------------------------
+
+
+class TestOptimizerRewrites:
+    def test_pushdown_and_prune_into_scan(self, tmp_path):
+        path = _write_shard(str(tmp_path))
+        rel = (
+            tfs.scan(path)
+            .filter(col("x") > 9000.0, selectivity=0.1)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+        )
+        node, decisions = rel.optimize()
+        accepted = {d["rule"] for d in decisions if d["accepted"]}
+        assert "pushdown_into_scan" in accepted, decisions
+        assert "prune_columns" in accepted, decisions
+        # the scan leaf carries the predicate + only the demanded cols
+        leaf = node
+        while leaf.inputs:
+            leaf = leaf.inputs[0]
+        assert leaf.op == "scan"
+        assert leaf.payload["predicate"] is not None
+        assert set(leaf.payload["columns"]) == {"x", "y"}
+
+    def test_pushdown_proven_by_decode_counters(self, tmp_path):
+        """Rows decoded ~= rows surviving the filter — NOT the file's
+        total row count: the pushdown decodes less, it does not mask
+        more."""
+        path = _write_shard(str(tmp_path), rows=10_000, blocks=10)
+        rel = (
+            tfs.scan(path)
+            .filter(col("x") > 9000.0, selectivity=0.1)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+        )
+        res = rel.force()
+        counters, _, _ = telemetry.metrics_snapshot()
+        decoded = counters.get("ingest_rows_decoded", 0.0)
+        assert 0 < decoded <= 1000, decoded  # one of ten row groups
+        assert planmod.state()["pushdown_rows_skipped"] == 9000
+        assert counters.get("plan_pushdown_rows_skipped") == 9000.0
+
+        # bit-identical to the rewrite-disabled execution
+        with tfs.config.override(plan_optimizer=False):
+            ref = (
+                tfs.scan(path)
+                .filter(col("x") > 9000.0, selectivity=0.1)
+                .map_blocks(_double_x(), feed_dict={"x": "x"})
+                .group_by("y")
+                .agg(z_sum=("sum", "z"))
+                .force()
+            )
+        counters2, _, _ = telemetry.metrics_snapshot()
+        assert counters2.get("ingest_rows_decoded", 0.0) >= 10_000
+        pd.testing.assert_frame_equal(res.to_pandas(), ref.to_pandas())
+
+    def test_nonselective_pushdown_is_cost_rejected(self, tmp_path):
+        """A ledger-priced regression rewrite is rejected AND visible in
+        tfs.explain(): at selectivity 1.0 the pushdown saves nothing and
+        still pays the arrow-boundary filter pass."""
+        path = _write_shard(str(tmp_path), rows=1000, blocks=4)
+        rel = tfs.scan(path).filter(col("x") > -1.0, selectivity=1.0)
+        txt = rel.explain_plan()
+        assert "REJECTED (regression)" in txt, txt
+        _, decisions = rel.optimize()
+        d = next(d for d in decisions if d["rule"] == "pushdown_into_scan")
+        assert not d["accepted"]
+        assert d["cost_after_s"] >= d["cost_before_s"] * (1 - 1e-9)
+        assert planmod.state()["rejected"].get("pushdown_into_scan") == 1
+
+    def test_filter_reorders_below_independent_map(self):
+        rel = (
+            _frame().lazy()
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .filter(col("x") > 100.0)
+        )
+        node, decisions = rel.optimize()
+        assert any(
+            d["rule"] == "filter_below_map" and d["accepted"]
+            for d in decisions
+        ), decisions
+        assert node.op == "map" and node.inputs[0].op == "filter"
+
+    def test_filter_on_map_output_does_not_reorder(self):
+        rel = (
+            _frame().lazy()
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .filter(col("z") > 100.0)  # depends on the map's output
+        )
+        node, _ = rel.optimize()
+        assert node.op == "filter" and node.inputs[0].op == "map"
+        got = rel.force().to_pandas()
+        assert (got["z"] > 100.0).all()
+
+    def test_adjacent_relational_maps_fuse(self):
+        rel = (
+            _frame().lazy()
+            .filter(col("x") > 100.0)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .map_blocks(_inc_z(), feed_dict={"z": "z"})
+        )
+        node, decisions = rel.optimize()
+        assert any(
+            d["rule"] == "fuse_maps" and d["accepted"] for d in decisions
+        )
+        assert node.op == "map" and len(node.payload["stages"]) == 2
+        got = rel.force().to_pandas()
+        ref = _frame().to_pandas()
+        ref = ref[ref.x > 100.0]
+        np.testing.assert_array_equal(
+            got["w2"].to_numpy(), (ref.x * 2.0 + 1.0).to_numpy()
+        )
+
+    def test_common_subplan_dedup_executes_once(self):
+        df = _frame(rows=120, blocks=2)
+        a = df.lazy().filter(col("x") > 60.0).select(["x", "y"])
+        b = df.lazy().filter(col("x") > 60.0).select(["x", "y"])
+        j = a.join(b, on=["x", "y"])
+        node, decisions = j.optimize()
+        assert any(
+            d["rule"] == "dedup" and d["accepted"] for d in decisions
+        )
+        assert node.inputs[0] is node.inputs[1]  # the SAME object
+        planmod.reset_state()
+        out = j.force()
+        # 4 unique nodes run (source, filter, select, join), not 7
+        assert planmod.state()["executed_nodes"] == 4
+        assert out.nrows == len(
+            df.to_pandas().query("x > 60.0")
+        )
+
+    def test_optimizer_off_is_identity(self):
+        rel = (
+            _frame().lazy()
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .filter(col("x") > 100.0)
+        )
+        with tfs.config.override(plan_optimizer=False):
+            node, decisions = rel.optimize()
+        assert decisions == []
+        assert node is rel._node
+
+
+# ---------------------------------------------------------------------------
+# canonical fingerprints + shared materialization-cache key
+# ---------------------------------------------------------------------------
+
+
+class TestPlanFingerprint:
+    def test_commutative_predicates_share_fingerprint(self):
+        df = _frame()
+        a = df.lazy().filter((col("x") > 10.0) & (col("y") < 2.0))
+        b = df.lazy().filter((col("y") < 2.0) & (col("x") > 10.0))
+        fa = planmod.plan_fingerprint(a.optimize()[0])
+        fb = planmod.plan_fingerprint(b.optimize()[0])
+        assert fa == fb
+
+    def test_pre_and_post_rewrite_converge(self, tmp_path):
+        """The as-written plan and its pushed-down form share one
+        fingerprint AFTER optimization (the canonical key is computed on
+        the optimized DAG)."""
+        path = _write_shard(str(tmp_path), rows=1000, blocks=4)
+        written = tfs.scan(path).filter(col("x") > 500.0, selectivity=0.2)
+        fp1 = written.plan().fingerprint()
+        fp2 = tfs.scan(path).filter(
+            col("x") > 500.0, selectivity=0.2
+        ).plan().fingerprint()
+        assert fp1 == fp2
+
+    def test_shared_plan_cache_hit_zero_dispatches(self, tmp_path):
+        path = _write_shard(str(tmp_path), rows=2000, blocks=4)
+
+        def build(flip):
+            pred = (
+                (col("y") < 2.0) & (col("x") > 10.0)
+                if flip
+                else (col("x") > 10.0) & (col("y") < 2.0)
+            )
+            return (
+                tfs.scan(path)
+                .filter(pred)
+                .map_blocks(_double_x(), feed_dict={"x": "x"})
+                .group_by("y")
+                .agg(z_sum=("sum", "z"))
+            )
+
+        with tfs.config.override(
+            materialize_cache_bytes=64 * 1024 * 1024,
+            materialize_cache_dir=str(tmp_path / "cache"),
+        ):
+            r1 = build(False).force()
+            # the relational result stores once; the inner fused map
+            # stage may store its own entry too (the lazy-path cache)
+            assert materialize.state()["stores"] >= 1
+            telemetry.reset()
+            r2 = build(True).force()  # commutatively reordered plan
+            assert not _dispatches(), [s.name for s in _dispatches()]
+            assert planmod.state()["cache_hits"] == 1
+            pd.testing.assert_frame_equal(r1.to_pandas(), r2.to_pandas())
+
+
+# ---------------------------------------------------------------------------
+# GlobalFrame lowering: one SPMD dispatch per stage, loud fallbacks
+# ---------------------------------------------------------------------------
+
+
+class TestGlobalLowering:
+    def test_one_dispatch_per_stage(self):
+        n = 4096
+        df = tfs.TensorFrame.from_dict(
+            {
+                "x": np.arange(n, dtype=np.float64),
+                "y": np.arange(n, dtype=np.float64) % 5,
+            }
+        )
+        gf = tfs.GlobalFrame.from_frame(df)
+        rel = (
+            gf.lazy()
+            .filter(col("x") > 2000.0)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+        )
+        res = rel.force()
+        names = [s.name for s in _dispatches()]
+        assert names == [
+            "plan.filter.mask",
+            "lazy.force.global",
+            "aggregate.segment",
+        ], names
+        assert not planmod.state()["fallbacks"]
+        ref = df.to_pandas()
+        ref = ref[ref.x > 2000.0].assign(z=lambda d: d.x * 2.0)
+        ref = ref.groupby("y", as_index=False).agg(z_sum=("z", "sum"))
+        got = res.to_pandas().sort_values("y").reset_index(drop=True)
+        pd.testing.assert_frame_equal(
+            got[["y", "z_sum"]], ref, check_dtype=False
+        )
+
+    def test_sort_and_join_fall_back_loudly(self):
+        df = _frame(rows=1024, blocks=4)
+        gf = tfs.GlobalFrame.from_frame(df)
+        out = gf.lazy().sort_by("x", descending=True).force()
+        assert out.to_pandas()["x"].iloc[0] == 1023.0
+        st = planmod.state()
+        assert st["fallbacks"].get("sort-global") == 1, st
+        counters, _, _ = telemetry.metrics_snapshot()
+        assert counters.get("plan_fallbacks{reason=sort-global}") == 1.0
+
+        right = tfs.TensorFrame.from_dict(
+            {
+                "y": np.arange(3, dtype=np.float64),
+                "label": np.array([1.0, 2.0, 3.0]),
+            }
+        )
+        j = gf.lazy().join(right.lazy(), on="y").force()
+        assert j.nrows == 1024
+        assert planmod.state()["fallbacks"].get("join-global") == 1
+
+
+# ---------------------------------------------------------------------------
+# explain / explain_analyze / diagnostics
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_explain_never_executes(self, tmp_path):
+        path = _write_shard(str(tmp_path), rows=1000, blocks=4)
+        rel = (
+            tfs.scan(path)
+            .filter(col("x") > 500.0, selectivity=0.2)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+        )
+        txt = tfs.explain(rel)
+        assert "pre-optimization" in txt
+        assert "optimized plan" in txt
+        assert "est" in txt and "ms" in txt  # per-node costed estimates
+        assert not _dispatches()
+        assert planmod.state()["executed_nodes"] == 0
+        counters, _, _ = telemetry.metrics_snapshot()
+        assert counters.get("ingest_rows_decoded") is None
+
+    def test_explain_on_lazyplan_handle(self):
+        p = _frame().lazy().filter(col("x") > 10.0).plan()
+        assert p.fingerprint()
+        assert "filter" in tfs.explain(p)
+
+    def test_explain_analyze_attributes_optimizer_stage(self, tmp_path):
+        path = _write_shard(str(tmp_path), rows=2000, blocks=4)
+        rel = (
+            tfs.scan(path)
+            .filter(col("x") > 100.0)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+        )
+        rep = tfs.explain_analyze(rel, format="json")
+        stages = {s["name"] for s in rep["stages"]}
+        assert "plan.optimize" in stages, stages
+        assert any(n.startswith("plan.") and n != "plan.optimize"
+                   for n in stages), stages
+        assert rep["coverage"] >= 0.9, rep["coverage"]
+
+    def test_diagnostics_has_plan_optimizer_section(self, tmp_path):
+        path = _write_shard(str(tmp_path), rows=10_000, blocks=10)
+        (
+            tfs.scan(path)
+            .filter(col("x") > 9000.0, selectivity=0.1)
+            .select(["x"])
+            .force()
+        )
+        data = tfs.telemetry.diagnostics_data()
+        po = data["plan_optimizer"]
+        assert po["forces"] == 1
+        assert po["rewrites"].get("pushdown_into_scan") == 1
+        assert po["pushdown_rows_skipped"] == 9000
+        txt = tfs.diagnostics()
+        assert "plan optimizer:" in txt
+        assert "predicate pushdown" in txt
+
+
+# ---------------------------------------------------------------------------
+# per-op-class throughput rollup feeds the planner
+# ---------------------------------------------------------------------------
+
+
+class TestPlannerThroughput:
+    def test_residuals_by_class_rollup(self):
+        df = _frame()
+        (
+            df.lazy()
+            .filter(col("x") > 100.0)
+            .map_blocks(_double_x(), feed_dict={"x": "x"})
+            .group_by("y")
+            .agg(z_sum=("sum", "z"))
+            .force()
+        )
+        from tensorframes_tpu.runtime import costmodel
+
+        res = costmodel.residuals()
+        assert "by_class" in res
+        for g in res["groups"]:
+            assert g["op_class"] in ("map", "reduce", "relational")
+
+    def test_planner_throughput_uncalibrated_is_none(self):
+        from tensorframes_tpu.runtime import costmodel
+
+        costmodel.reset()
+        assert costmodel.planner_throughput("relational") is None
